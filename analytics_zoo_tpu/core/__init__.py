@@ -1,0 +1,66 @@
+"""Core module system: layers, containers, criterions, model wrapper.
+
+TPU-native replacement for the BigDL runtime surface consumed by the
+reference zoo (SURVEY.md §2.7): AbstractModule/Container/Sequential/Graph,
+the ~25 stock layers, and the criterion zoo.  Everything is functional —
+``init(rng) -> variables`` / ``apply(variables, x)`` — so it composes with
+jit/pjit/vmap/scan.
+"""
+
+from analytics_zoo_tpu.core.module import (
+    Model,
+    Module,
+    Sequential,
+    ConcatTable,
+    ParallelTable,
+    JoinTable,
+    SelectTable,
+    FlattenTable,
+    CAddTable,
+    Identity,
+    Lambda,
+)
+from analytics_zoo_tpu.core.layers import (
+    Linear,
+    SpatialConvolution,
+    SpatialDilatedConvolution,
+    SpatialMaxPooling,
+    SpatialAveragePooling,
+    ReLU,
+    LogSoftMax,
+    SoftMax,
+    Sigmoid,
+    Tanh,
+    Dropout,
+    BatchNormalization,
+    SequenceBatchNormalization,
+    LookupTable,
+    Normalize,
+    CMul,
+    NormalizeScale,
+    Transpose,
+    Reshape,
+    InferReshape,
+    Squeeze,
+    Select,
+    Reverse,
+)
+from analytics_zoo_tpu.core.rnn import (
+    RnnCell,
+    GRUCell,
+    LSTMCell,
+    Recurrent,
+    BiRecurrent,
+)
+from analytics_zoo_tpu.core.criterion import (
+    Criterion,
+    ClassNLLCriterion,
+    CrossEntropyCriterion,
+    BCECriterion,
+    SmoothL1Criterion,
+    MSECriterion,
+    ParallelCriterion,
+    CTCCriterion,
+)
+
+__all__ = [k for k in dir() if not k.startswith("_")]

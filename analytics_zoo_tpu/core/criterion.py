@@ -1,0 +1,152 @@
+"""Criterions (loss functions) — pure jittable functions with BigDL names.
+
+Replaces the BigDL ``AbstractCriterion`` family consumed by the reference
+(SURVEY.md §2.7 "Criterions"): SmoothL1Criterion, ClassNLLCriterion,
+BCECriterion, ParallelCriterion.  A criterion is a callable
+``loss = crit(input, target)`` returning a scalar; optional ``mask`` kwargs
+support the padded/ragged batches the data layer produces.
+
+The SSD MultiBoxLoss lives in ``analytics_zoo_tpu.ops.multibox_loss`` with
+the rest of the detection math.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class Criterion:
+    """Base class; subclasses implement ``__call__(input, target) -> scalar``."""
+
+    def __call__(self, inputs, target):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+def _reduce(x, mask=None, size_average: bool = True):
+    if mask is not None:
+        x = x * mask
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+    else:
+        denom = x.size
+    total = jnp.sum(x)
+    return total / denom if size_average else total
+
+
+class ClassNLLCriterion(Criterion):
+    """Negative log-likelihood over log-probabilities (BigDL semantics:
+    pairs with a ``LogSoftMax`` output layer). Targets are 0-based ints."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def __call__(self, log_probs, target, mask=None):
+        target = target.astype(jnp.int32)
+        nll = -jnp.take_along_axis(log_probs, target[..., None], axis=-1)[..., 0]
+        return _reduce(nll, mask, self.size_average)
+
+
+class CrossEntropyCriterion(Criterion):
+    """Softmax cross-entropy over raw logits (= LogSoftMax + ClassNLL fused,
+    the numerically preferred on-TPU form)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def __call__(self, logits, target, mask=None):
+        nll = optax.softmax_cross_entropy_with_integer_labels(
+            logits, target.astype(jnp.int32)
+        )
+        return _reduce(nll, mask, self.size_average)
+
+
+class BCECriterion(Criterion):
+    """Binary cross-entropy on probabilities in (0,1) (BigDL ``BCECriterion``,
+    sentiment notebook head)."""
+
+    def __init__(self, size_average: bool = True, eps: float = 1e-7):
+        self.size_average = size_average
+        self.eps = eps
+
+    def __call__(self, probs, target, mask=None):
+        p = jnp.clip(probs, self.eps, 1.0 - self.eps)
+        bce = -(target * jnp.log(p) + (1.0 - target) * jnp.log(1.0 - p))
+        return _reduce(bce, mask, self.size_average)
+
+
+def smooth_l1(diff: jax.Array, sigma: float = 1.0) -> jax.Array:
+    """Elementwise smooth-L1 (Huber) with Caffe's sigma parameterization:
+    0.5·(σd)² for |d| < 1/σ², else |d| − 0.5/σ²  (reference
+    ``common/nn/MultiBoxLoss.scala`` loc loss)."""
+    s2 = sigma * sigma
+    ad = jnp.abs(diff)
+    return jnp.where(ad < 1.0 / s2, 0.5 * s2 * diff * diff, ad - 0.5 / s2)
+
+
+class SmoothL1Criterion(Criterion):
+    def __init__(self, size_average: bool = True, sigma: float = 1.0):
+        self.size_average = size_average
+        self.sigma = sigma
+
+    def __call__(self, inputs, target, mask=None):
+        return _reduce(smooth_l1(inputs - target, self.sigma), mask, self.size_average)
+
+
+class MSECriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def __call__(self, inputs, target, mask=None):
+        return _reduce((inputs - target) ** 2, mask, self.size_average)
+
+
+class ParallelCriterion(Criterion):
+    """Weighted sum of sub-criterions over paired (input, target) tuples
+    (BigDL ``ParallelCriterion``; used by the Caffe loss importer)."""
+
+    def __init__(self, criterions: Sequence[Tuple[Criterion, float]] = ()):
+        self.criterions = list(criterions)
+
+    def add(self, criterion: Criterion, weight: float = 1.0) -> "ParallelCriterion":
+        self.criterions.append((criterion, weight))
+        return self
+
+    def __call__(self, inputs, targets):
+        if len(inputs) != len(self.criterions) or len(targets) != len(self.criterions):
+            raise ValueError(
+                f"ParallelCriterion has {len(self.criterions)} sub-criterions but got "
+                f"{len(inputs)} inputs / {len(targets)} targets"
+            )
+        total = 0.0
+        for (crit, w), inp, tgt in zip(self.criterions, inputs, targets):
+            total = total + w * crit(inp, tgt)
+        return total
+
+
+class CTCCriterion(Criterion):
+    """CTC loss for DS2 training (net-new vs the inference-only reference;
+    the reference's decoder alphabet reserves index 0 as the CTC blank,
+    ``deepspeech2/.../Decoder.scala``)."""
+
+    def __init__(self, blank_id: int = 0):
+        self.blank_id = blank_id
+
+    def __call__(self, log_probs, labels, logit_mask=None, label_mask=None):
+        """``logit_mask``/``label_mask`` follow the framework convention
+        (1.0 = valid element, like every other criterion here); they are
+        inverted into optax's padding convention internally."""
+        B, T = log_probs.shape[0], log_probs.shape[1]
+        logit_pad = (
+            jnp.zeros((B, T)) if logit_mask is None else 1.0 - logit_mask
+        )
+        label_pad = (
+            jnp.zeros(labels.shape[:2]) if label_mask is None else 1.0 - label_mask
+        )
+        per_seq = optax.ctc_loss(
+            log_probs, logit_pad, labels.astype(jnp.int32), label_pad,
+            blank_id=self.blank_id,
+        )
+        return jnp.mean(per_seq)

@@ -1,0 +1,372 @@
+"""Stock layers with BigDL-parity names, implemented TPU-first.
+
+Covers the ~25 BigDL layers the reference zoo consumes (SURVEY.md §2.7
+"Module system").  Conventions differ from BigDL where TPU idiom demands it:
+
+- **Layout is NHWC** (batch, height, width, channel) — the native XLA:TPU
+  convolution layout — not BigDL's NCHW.  ``Transpose`` is available for
+  explicit layout moves at the data boundary.
+- Parameters default to float32 with bfloat16-friendly initializers; mixed
+  precision is applied at the train-step level, not per-layer.
+- Pooling supports Caffe-style ``ceil_mode`` because the SSD/VGG pool
+  geometry depends on it (reference ``ssd/model/SSD.scala`` pool layers).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+IntPair = Union[int, Tuple[int, int]]
+
+
+def _pair(v: IntPair) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+# ---------------------------------------------------------------------------
+# Dense / conv / pool
+# ---------------------------------------------------------------------------
+
+
+class Linear(nn.Module):
+    """Fully-connected layer (BigDL ``Linear``)."""
+
+    out_features: int
+    use_bias: bool = True
+    kernel_init: Callable = nn.initializers.xavier_uniform()
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(
+            self.out_features, use_bias=self.use_bias, kernel_init=self.kernel_init
+        )(x)
+
+
+class SpatialConvolution(nn.Module):
+    """2-D convolution, NHWC (BigDL ``SpatialConvolution``, MKL → MXU).
+
+    ``padding`` accepts an int/pair (symmetric, Caffe-style) or "SAME"/"VALID".
+    """
+
+    out_channels: int
+    kernel_size: IntPair = 3
+    stride: IntPair = 1
+    padding: Any = 0
+    dilation: IntPair = 1
+    groups: int = 1
+    use_bias: bool = True
+    kernel_init: Callable = nn.initializers.xavier_uniform()
+
+    @nn.compact
+    def __call__(self, x):
+        pad = self.padding
+        if isinstance(pad, (int, tuple, list)):
+            ph, pw = _pair(pad)
+            pad = ((ph, ph), (pw, pw))
+        return nn.Conv(
+            features=self.out_channels,
+            kernel_size=_pair(self.kernel_size),
+            strides=_pair(self.stride),
+            padding=pad,
+            kernel_dilation=_pair(self.dilation),
+            feature_group_count=self.groups,
+            use_bias=self.use_bias,
+            kernel_init=self.kernel_init,
+        )(x)
+
+
+class SpatialDilatedConvolution(SpatialConvolution):
+    """Dilated conv (BigDL ``SpatialDilatedConvolution``, SSD fc6 dilation 6,
+    reference ``ssd/model/SSD.scala`` fc6)."""
+
+
+def _pool_out_dim(size, win, stride, pad, ceil_mode):
+    import math
+
+    if ceil_mode:
+        out = math.ceil((size + 2 * pad - win) / stride) + 1
+        # Caffe clamp: the last window must start inside the (left-padded)
+        # input, otherwise it would lie entirely in padding.
+        if (out - 1) * stride >= size + pad:
+            out -= 1
+    else:
+        out = (size + 2 * pad - win) // stride + 1
+    return out
+
+
+def _pool(x, window, stride, padding, ceil_mode, reducer, init_value,
+          average=False, count_include_pad=True):
+    wh, ww = window
+    sh, sw = stride
+    ph, pw = padding
+    B, H, W, C = x.shape
+    out_h = _pool_out_dim(H, wh, sh, ph, ceil_mode)
+    out_w = _pool_out_dim(W, ww, sw, pw, ceil_mode)
+    # Right/bottom padding sized so reduce_window emits exactly (out_h, out_w).
+    pads = [
+        ph, max((out_h - 1) * sh + wh - H - ph, 0),
+        pw, max((out_w - 1) * sw + ww - W - pw, 0),
+    ]
+    padding_cfg = ((0, 0), (pads[0], pads[1]), (pads[2], pads[3]), (0, 0))
+    y = jax.lax.reduce_window(
+        x, init_value, reducer,
+        window_dimensions=(1, wh, ww, 1),
+        window_strides=(1, sh, sw, 1),
+        padding=padding_cfg,
+    )
+    if average:
+        if count_include_pad:
+            # BigDL/Caffe default: divide by the full window size.
+            y = y / (wh * ww)
+        else:
+            ones = jnp.ones((1, H, W, 1), dtype=x.dtype)
+            counts = jax.lax.reduce_window(
+                ones, 0.0, jax.lax.add,
+                window_dimensions=(1, wh, ww, 1),
+                window_strides=(1, sh, sw, 1),
+                padding=padding_cfg,
+            )
+            y = y / jnp.maximum(counts, 1.0)
+    return y
+
+
+class SpatialMaxPooling(nn.Module):
+    kernel_size: IntPair = 2
+    stride: Optional[IntPair] = None
+    padding: IntPair = 0
+    ceil_mode: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        stride = self.stride if self.stride is not None else self.kernel_size
+        return _pool(
+            x, _pair(self.kernel_size), _pair(stride), _pair(self.padding),
+            self.ceil_mode, jax.lax.max, -jnp.inf,
+        )
+
+
+class SpatialAveragePooling(nn.Module):
+    kernel_size: IntPair = 2
+    stride: Optional[IntPair] = None
+    padding: IntPair = 0
+    ceil_mode: bool = False
+    global_pool: bool = False
+    count_include_pad: bool = True  # BigDL/Caffe default
+
+    @nn.compact
+    def __call__(self, x):
+        if self.global_pool:
+            return jnp.mean(x, axis=(1, 2), keepdims=True)
+        stride = self.stride if self.stride is not None else self.kernel_size
+        return _pool(
+            x, _pair(self.kernel_size), _pair(stride), _pair(self.padding),
+            self.ceil_mode, jax.lax.add, 0.0, average=True,
+            count_include_pad=self.count_include_pad,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Activations / regularization
+# ---------------------------------------------------------------------------
+
+
+class ReLU(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.relu(x)
+
+
+class LogSoftMax(nn.Module):
+    axis: int = -1
+
+    @nn.compact
+    def __call__(self, x):
+        return jax.nn.log_softmax(x, axis=self.axis)
+
+
+class SoftMax(nn.Module):
+    axis: int = -1
+
+    @nn.compact
+    def __call__(self, x):
+        return jax.nn.softmax(x, axis=self.axis)
+
+
+class Sigmoid(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return jax.nn.sigmoid(x)
+
+
+class Tanh(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return jnp.tanh(x)
+
+
+class Dropout(nn.Module):
+    rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.Dropout(rate=self.rate, deterministic=not train)(x)
+
+
+class BatchNormalization(nn.Module):
+    """Batch norm over the trailing feature axis (BigDL ``BatchNormalization``
+    / ``SpatialBatchNormalization`` — NHWC makes them the same op)."""
+
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.BatchNorm(
+            use_running_average=not train,
+            momentum=self.momentum,
+            epsilon=self.epsilon,
+        )(x)
+
+
+class SequenceBatchNormalization(BatchNormalization):
+    """Sequence-wise BN: stats over (batch, time) jointly for [B, T, D] input.
+
+    Reference ``deepspeech2/.../bigdl/nn/BatchNormalizationDS.scala:24``
+    reshapes [B,T,D]→[B·T,D] around BN; with feature-axis BN that reshape is
+    the identity, so this subclass exists for naming parity and intent.
+    """
+
+
+# ---------------------------------------------------------------------------
+# Embedding / normalization / scaling
+# ---------------------------------------------------------------------------
+
+
+class LookupTable(nn.Module):
+    """Embedding lookup (BigDL ``LookupTable``; ids are 0-based here)."""
+
+    vocab_size: int
+    embedding_dim: int
+    embedding_init: Callable = nn.initializers.normal(stddev=0.05)
+
+    @nn.compact
+    def __call__(self, ids):
+        return nn.Embed(
+            num_embeddings=self.vocab_size,
+            features=self.embedding_dim,
+            embedding_init=self.embedding_init,
+        )(ids.astype(jnp.int32))
+
+
+class Normalize(nn.Module):
+    """Lp-normalize across ``axis`` (BigDL ``Normalize``; p=2 for SSD)."""
+
+    p: float = 2.0
+    axis: int = -1
+    eps: float = 1e-10
+
+    @nn.compact
+    def __call__(self, x):
+        if self.p == 2.0:
+            norm = jnp.sqrt(jnp.sum(x * x, axis=self.axis, keepdims=True))
+        else:
+            norm = jnp.sum(jnp.abs(x) ** self.p, axis=self.axis, keepdims=True) ** (
+                1.0 / self.p
+            )
+        return x / (norm + self.eps)
+
+
+class CMul(nn.Module):
+    """Learnable elementwise scale broadcast over the batch (BigDL ``CMul``)."""
+
+    shape: Sequence[int]
+    init_value: Optional[float] = None
+
+    @nn.compact
+    def __call__(self, x):
+        if self.init_value is None:
+            init = nn.initializers.ones
+        else:
+            init = nn.initializers.constant(self.init_value)
+        scale = self.param("weight", init, tuple(self.shape), x.dtype)
+        return x * scale
+
+
+class NormalizeScale(nn.Module):
+    """L2-normalize channels then learnable per-channel scale.
+
+    The SSD conv4_3 normalization (reference
+    ``common/nn/NormalizeScale.scala:28``: Normalize + CMul, scale init 20).
+    Operates on the trailing channel axis of NHWC input.
+    """
+
+    channels: int
+    scale: float = 20.0
+    p: float = 2.0
+    eps: float = 1e-10
+
+    @nn.compact
+    def __call__(self, x):
+        y = Normalize(p=self.p, axis=-1, eps=self.eps)(x)
+        return CMul(shape=(self.channels,), init_value=self.scale, name="cmul")(y)
+
+
+# ---------------------------------------------------------------------------
+# Shape plumbing
+# ---------------------------------------------------------------------------
+
+
+class Transpose(nn.Module):
+    perm: Sequence[int]
+
+    @nn.compact
+    def __call__(self, x):
+        return jnp.transpose(x, self.perm)
+
+
+class Reshape(nn.Module):
+    shape: Sequence[int]
+    batch_mode: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        if self.batch_mode:
+            return jnp.reshape(x, (x.shape[0],) + tuple(self.shape))
+        return jnp.reshape(x, tuple(self.shape))
+
+
+class InferReshape(Reshape):
+    """Reshape with -1 wildcard (BigDL ``InferReshape``) — jnp already infers."""
+
+
+class Squeeze(nn.Module):
+    axis: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, x):
+        return jnp.squeeze(x, axis=self.axis)
+
+
+class Select(nn.Module):
+    """Select one index along an axis (BigDL ``Select``, 0-based here)."""
+
+    axis: int
+    index: int
+
+    @nn.compact
+    def __call__(self, x):
+        return jnp.take(x, self.index, axis=self.axis)
+
+
+class Reverse(nn.Module):
+    """Reverse along an axis (BigDL ``Reverse``; DS2 uses time axis)."""
+
+    axis: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        return jnp.flip(x, axis=self.axis)

@@ -1,0 +1,240 @@
+"""Module system: flax.linen-based layers with a BigDL-parity container surface.
+
+The reference builds networks with BigDL's ``AbstractModule`` containers —
+``Sequential``, ``Graph`` (node ``.inputs`` wiring), and the Table family
+(``ConcatTable``/``ParallelTable``/``JoinTable``/``SelectTable``/``CAddTable``)
+— see e.g. reference ``pipeline/ssd/.../ssd/model/SSD.scala`` and
+``SSDGraph.scala``.  Here the same combinators are expressed as flax modules,
+so arbitrary BigDL-style assemblies translate one-to-one while remaining pure
+functions that XLA can fuse.
+
+Functional contract (all modules):
+  variables = module.init(rng, *example_inputs)
+  y         = module.apply(variables, *inputs)
+Stateful layers (BatchNorm) keep running stats in the ``batch_stats``
+collection; ``Model`` below hides the plumbing for users who want the
+object-style ``forward`` of the reference.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax import serialization
+from flax.core import FrozenDict
+
+Module = nn.Module
+
+
+class Lambda(nn.Module):
+    """Wrap a pure function as a module (no parameters)."""
+
+    fn: Callable[..., Any]
+
+    @nn.compact
+    def __call__(self, *args, **kwargs):
+        return self.fn(*args, **kwargs)
+
+
+class Identity(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return x
+
+
+class Sequential(nn.Module):
+    """Chain of sub-modules applied in order.
+
+    Mirrors BigDL ``Sequential().add(...)`` (reference
+    ``ssd/model/SSD.scala:44``); construction is by list instead of mutation
+    so the module stays a frozen dataclass.
+    """
+
+    layers: Sequence[nn.Module]
+
+    @nn.compact
+    def __call__(self, x, **kwargs):
+        for layer in self.layers:
+            x = _apply_child(layer, x, **kwargs)
+        return x
+
+
+class ConcatTable(nn.Module):
+    """Apply every child to the same input, return a tuple of outputs.
+
+    Reference: BigDL ``ConcatTable`` used for the SSD multi-head plumbing
+    (``ssd/model/SSD.scala:196``).
+    """
+
+    layers: Sequence[nn.Module]
+
+    @nn.compact
+    def __call__(self, x, **kwargs):
+        return tuple(_apply_child(layer, x, **kwargs) for layer in self.layers)
+
+
+class ParallelTable(nn.Module):
+    """Apply the i-th child to the i-th element of the input tuple."""
+
+    layers: Sequence[nn.Module]
+
+    @nn.compact
+    def __call__(self, xs, **kwargs):
+        return tuple(
+            _apply_child(layer, x, **kwargs) for layer, x in zip(self.layers, xs)
+        )
+
+
+class JoinTable(nn.Module):
+    """Concatenate a tuple of tensors along ``axis``.
+
+    Reference: BigDL ``JoinTable`` (head concat in ``SSD.scala:213``).
+    ``axis`` counts the batch dimension (axis 0), matching jnp semantics.
+    """
+
+    axis: int = -1
+
+    @nn.compact
+    def __call__(self, xs):
+        return jnp.concatenate(list(xs), axis=self.axis)
+
+
+class SelectTable(nn.Module):
+    index: int = 0
+
+    @nn.compact
+    def __call__(self, xs):
+        return xs[self.index]
+
+
+class FlattenTable(nn.Module):
+    @nn.compact
+    def __call__(self, xs):
+        flat: list = []
+
+        def rec(t):
+            if isinstance(t, (tuple, list)):
+                for u in t:
+                    rec(u)
+            else:
+                flat.append(t)
+
+        rec(xs)
+        return tuple(flat)
+
+
+class CAddTable(nn.Module):
+    """Elementwise sum of a tuple of tensors (BigDL ``CAddTable``)."""
+
+    @nn.compact
+    def __call__(self, xs):
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out
+
+
+def accepted_kwargs(module: nn.Module, kwargs: dict) -> dict:
+    """Subset of ``kwargs`` that ``module.__call__`` accepts by name."""
+    if not kwargs:
+        return kwargs
+    sig = inspect.signature(type(module).__call__)
+    if any(p.kind is inspect.Parameter.VAR_KEYWORD for p in sig.parameters.values()):
+        return kwargs
+    return {k: v for k, v in kwargs.items() if k in sig.parameters}
+
+
+def _apply_child(layer: nn.Module, x, **kwargs):
+    """Apply a child module, forwarding only kwargs it accepts by signature.
+
+    Lets containers pass ``train=...`` through mixed stacks where only some
+    layers (Dropout/BatchNorm) care about mode flags, without masking real
+    TypeErrors raised inside the child.
+    """
+    return layer(x, **accepted_kwargs(layer, kwargs))
+
+
+class Model:
+    """Object-style wrapper bundling a module definition with its variables.
+
+    Provides the reference's ``module.forward`` / ``Module.save`` /
+    ``Module.load`` ergonomics (SURVEY.md §2.7 "Module system") on top of
+    the functional core.  ``forward`` is jitted on first call.
+    """
+
+    def __init__(self, module: nn.Module, variables: Optional[Any] = None):
+        self.module = module
+        self.variables = variables
+        self._jit_apply = None
+        self.training = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def build(self, rng, *example_inputs, **kwargs) -> "Model":
+        if isinstance(rng, int):
+            rng = jax.random.PRNGKey(rng)
+        self.variables = self.module.init(rng, *example_inputs, **kwargs)
+        return self
+
+    @property
+    def params(self):
+        v = self.variables
+        return v["params"] if "params" in v else v
+
+    def evaluate(self) -> "Model":
+        """Switch to inference mode (reference ``model.evaluate()``)."""
+        self.training = False
+        return self
+
+    def train(self) -> "Model":
+        self.training = True
+        return self
+
+    # -- forward -----------------------------------------------------------
+    def forward(self, *inputs, rng: Optional[jax.Array] = None):
+        kwargs = {}
+        if rng is not None:
+            kwargs["rngs"] = {"dropout": rng}
+        if self._jit_apply is None:
+            self._jit_apply = jax.jit(
+                lambda variables, *a: self.module.apply(variables, *a)
+            )
+        if self.training:
+            # Training-mode forward (batch stats update, dropout) is not
+            # jitted here; the train-step factories in parallel/train.py own
+            # the jitted mutable path.
+            call_kwargs = accepted_kwargs(self.module, {"train": True})
+            out = self.module.apply(
+                self.variables, *inputs, mutable=["batch_stats"],
+                **call_kwargs, **kwargs,
+            )[0]
+            return out
+        return self._jit_apply(self.variables, *inputs)
+
+    __call__ = forward
+
+    # -- serialization -----------------------------------------------------
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            f.write(serialization.to_bytes(self.variables))
+
+    def load(self, path: str) -> "Model":
+        with open(path, "rb") as f:
+            data = f.read()
+        if self.variables is None:
+            raise ValueError("build() the model before load() to fix the tree shape")
+        self.variables = serialization.from_bytes(self.variables, data)
+        return self
+
+    def load_weights(self, tree) -> "Model":
+        """Copy a params pytree (e.g. from a converter) into this model."""
+        new = serialization.from_state_dict(
+            self.variables["params"], serialization.to_state_dict(tree)
+        )
+        base = dict(self.variables)
+        base["params"] = new
+        self.variables = FrozenDict(base) if isinstance(self.variables, FrozenDict) else base
+        return self

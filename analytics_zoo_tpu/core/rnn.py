@@ -1,0 +1,127 @@
+"""Recurrent layers via ``lax.scan`` — compiler-friendly TPU recurrence.
+
+Replaces the reference's BigDL ``Recurrent``/``Cell`` machinery and the DS2
+extensions (``RnnCellDS``, ``BiRecurrentDS`` — reference
+``pipeline/deepspeech2/src/main/scala/com/intel/analytics/bigdl/nn/*``).
+Time is axis 1 ([B, T, D]); the scan is unrolled by XLA into a fused loop,
+and the bidirectional pass is a flip + second scan (no dynamic shapes).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class RnnCell(nn.Module):
+    """Vanilla RNN cell: ``h' = act(W_i x + W_h h + b)``.
+
+    With ``identity_input=True`` the input projection is the identity — the
+    DS2 trick where inputs are pre-projected by the preceding conv/linear
+    (reference ``bigdl/nn/RNN.scala:28`` ``RnnCellDS`` identity i2h).  In that
+    mode the input width must equal ``hidden_size``.
+    """
+
+    hidden_size: int
+    identity_input: bool = False
+    activation: str = "relu"  # DS2 uses clipped ReLU
+
+    @nn.compact
+    def __call__(self, carry, x):
+        h = carry
+        pre = x if self.identity_input else nn.Dense(self.hidden_size, name="i2h")(x)
+        pre = pre + nn.Dense(self.hidden_size, name="h2h", use_bias=True)(h)
+        if self.activation == "relu":
+            new_h = nn.relu(pre)
+        elif self.activation == "clipped_relu":
+            new_h = jnp.clip(pre, 0.0, 20.0)
+        else:
+            new_h = jnp.tanh(pre)
+        return new_h, new_h
+
+    def initial_carry(self, batch: int, dtype=jnp.float32):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+
+class GRUCell(nn.Module):
+    hidden_size: int
+
+    @nn.compact
+    def __call__(self, carry, x):
+        cell = nn.GRUCell(features=self.hidden_size, name="gru")
+        new_h, y = cell(carry, x)
+        return new_h, y
+
+    def initial_carry(self, batch: int, dtype=jnp.float32):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+
+class LSTMCell(nn.Module):
+    hidden_size: int
+
+    @nn.compact
+    def __call__(self, carry, x):
+        cell = nn.OptimizedLSTMCell(features=self.hidden_size, name="lstm")
+        new_c, y = cell(carry, x)
+        return new_c, y
+
+    def initial_carry(self, batch: int, dtype=jnp.float32):
+        z = jnp.zeros((batch, self.hidden_size), dtype)
+        return (z, z)
+
+
+class Recurrent(nn.Module):
+    """Run a cell over time axis 1: [B, T, D] → [B, T, H].
+
+    BigDL ``Recurrent().add(cell)`` equivalent; the loop is a single
+    ``nn.scan`` so weights are shared across steps and XLA compiles one body.
+    """
+
+    cell: nn.Module
+    reverse: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        if self.reverse:
+            x = jnp.flip(x, axis=1)
+        scan = nn.scan(
+            type(self.cell),
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=1,
+            out_axes=1,
+        )
+        cell_kwargs = {
+            k: getattr(self.cell, k)
+            for k in type(self.cell).__dataclass_fields__
+            if k not in ("parent", "name")
+        }
+        carry = self.cell.initial_carry(x.shape[0], x.dtype)
+        _, ys = scan(**cell_kwargs, name="body")(carry, x)
+        if self.reverse:
+            ys = jnp.flip(ys, axis=1)
+        return ys
+
+
+class BiRecurrent(nn.Module):
+    """Bidirectional recurrence, forward + time-reversed backward pass.
+
+    Reference ``bigdl/nn/BiRecurrentDS.scala:26``: a fwd/rev ``Recurrent``
+    pair with ``Reverse`` on the time dim, merged by ``CAddTable`` (sum) or
+    concat.  ``merge='sum'`` reproduces DS2; ``merge='concat'`` is the
+    general BiLSTM used by the sentiment notebook.
+    """
+
+    cell: nn.Module
+    merge: str = "sum"  # 'sum' | 'concat'
+
+    @nn.compact
+    def __call__(self, x):
+        fwd = Recurrent(cell=self.cell, name="fwd")(x)
+        bwd = Recurrent(cell=self.cell, reverse=True, name="bwd")(x)
+        if self.merge == "sum":
+            return fwd + bwd
+        return jnp.concatenate([fwd, bwd], axis=-1)

@@ -1,0 +1,1 @@
+"""Utilities: engine/topology init, checkpointing, summaries, config."""

@@ -1,0 +1,24 @@
+"""Pytest bootstrap: force an 8-device virtual CPU mesh for all tests.
+
+Must run before any test imports jax functionality that initializes a
+backend.  The environment registers a remote TPU backend ("axon") and
+overrides ``jax_platforms``; tests need the deterministic local CPU path
+with 8 virtual devices so multi-chip sharding logic is exercised without
+TPU hardware (SURVEY.md §4 "Implication for the TPU build").
+"""
+
+import os
+
+# Must be set before the first jax import in this process.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The axon plugin's register() does jax.config.update("jax_platforms",
+# "axon,cpu") at interpreter start, which would make every backend touch
+# dial the TPU relay.  Point jax back at local CPU for the test session.
+jax.config.update("jax_platforms", "cpu")

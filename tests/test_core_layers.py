@@ -1,0 +1,235 @@
+"""Core layer/container numerics (mirrors the reference's per-op unit-test
+style, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu import core as C
+
+
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+class TestContainers:
+    def test_sequential_linear(self):
+        net = C.Sequential([C.Linear(4), C.ReLU(), C.Linear(2), C.LogSoftMax()])
+        x = jnp.ones((3, 8))
+        v = net.init(rng(), x)
+        y = net.apply(v, x)
+        assert y.shape == (3, 2)
+        np.testing.assert_allclose(np.exp(y).sum(-1), 1.0, rtol=1e-5)
+
+    def test_concat_join_table(self):
+        net = C.Sequential([
+            C.ConcatTable([C.Linear(3), C.Linear(5)]),
+            C.JoinTable(axis=-1),
+        ])
+        x = jnp.ones((2, 4))
+        v = net.init(rng(), x)
+        assert net.apply(v, x).shape == (2, 8)
+
+    def test_parallel_cadd(self):
+        net = C.Sequential([
+            C.ParallelTable([C.Identity(), C.Identity()]),
+            C.CAddTable(),
+        ])
+        xs = (jnp.ones((2, 3)), 2 * jnp.ones((2, 3)))
+        v = net.init(rng(), xs)
+        np.testing.assert_allclose(net.apply(v, xs), 3.0)
+
+    def test_select_flatten_table(self):
+        st = C.SelectTable(1)
+        assert st.apply(st.init(rng(), (1, 2)), (jnp.zeros(1), jnp.ones(1)))[0] == 1.0
+        ft = C.FlattenTable()
+        out = ft.apply(ft.init(rng(), ((jnp.zeros(1),),)), ((jnp.zeros(1), (jnp.ones(1),)),))
+        assert len(out) == 2
+
+
+class TestConvPool:
+    def test_conv_shapes(self):
+        x = jnp.ones((2, 16, 16, 3))
+        conv = C.SpatialConvolution(8, kernel_size=3, stride=1, padding=1)
+        v = conv.init(rng(), x)
+        assert conv.apply(v, x).shape == (2, 16, 16, 8)
+
+    def test_dilated_conv(self):
+        # SSD fc6: 3x3 dilation 6 pad 6 keeps spatial dims.
+        x = jnp.ones((1, 19, 19, 4))
+        conv = C.SpatialDilatedConvolution(8, kernel_size=3, padding=6, dilation=6)
+        v = conv.init(rng(), x)
+        assert conv.apply(v, x).shape == (1, 19, 19, 8)
+
+    def test_maxpool_ceil_mode(self):
+        # Caffe-SSD pool geometry: 75x75 → ceil → 38x38 (vs floor 37).
+        x = jnp.ones((1, 75, 75, 2))
+        pool = C.SpatialMaxPooling(kernel_size=2, stride=2, ceil_mode=True)
+        v = pool.init(rng(), x)
+        assert pool.apply(v, x).shape == (1, 38, 38, 2)
+        pool_f = C.SpatialMaxPooling(kernel_size=2, stride=2, ceil_mode=False)
+        assert pool_f.apply(pool_f.init(rng(), x), x).shape == (1, 37, 37, 2)
+
+    def test_avgpool_counts(self):
+        x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+        pool = C.SpatialAveragePooling(kernel_size=2, stride=2)
+        y = pool.apply(pool.init(rng(), x), x)
+        np.testing.assert_allclose(y[0, 0, 0, 0], (0 + 1 + 4 + 5) / 4)
+
+    def test_ceil_mode_clamp_no_pad_window(self):
+        # k=2,s=2,pad=1,ceil on 3x3: unclamped out would be 3 with the last
+        # window entirely in padding (-inf/NaN); Caffe clamps to 2x2.
+        x = jnp.ones((1, 3, 3, 1))
+        mp = C.SpatialMaxPooling(kernel_size=2, stride=2, padding=1, ceil_mode=True)
+        y = mp.apply(mp.init(rng(), x), x)
+        assert y.shape == (1, 2, 2, 1)
+        assert np.isfinite(np.asarray(y)).all()
+        ap = C.SpatialAveragePooling(kernel_size=2, stride=2, padding=1, ceil_mode=True)
+        ya = ap.apply(ap.init(rng(), x), x)
+        assert np.isfinite(np.asarray(ya)).all()
+
+    def test_avgpool_count_include_pad(self):
+        # BigDL/Caffe default: padded cells count in the divisor.
+        x = jnp.ones((1, 2, 2, 1))
+        ap = C.SpatialAveragePooling(kernel_size=2, stride=2, padding=1)
+        y = ap.apply(ap.init(rng(), x), x)
+        np.testing.assert_allclose(np.asarray(y[0, 0, 0, 0]), 0.25)
+        ap2 = C.SpatialAveragePooling(kernel_size=2, stride=2, padding=1,
+                                      count_include_pad=False)
+        y2 = ap2.apply(ap2.init(rng(), x), x)
+        np.testing.assert_allclose(np.asarray(y2[0, 0, 0, 0]), 1.0)
+
+
+class TestNormScale:
+    def test_normalize_l2(self):
+        x = jnp.array([[3.0, 4.0]])
+        n = C.Normalize(p=2.0)
+        y = n.apply(n.init(rng(), x), x)
+        np.testing.assert_allclose(y, [[0.6, 0.8]], rtol=1e-6)
+
+    def test_normalize_scale_init(self):
+        # conv4_3 scale init 20 (reference NormalizeScale.scala:28)
+        x = jnp.ones((1, 2, 2, 4))
+        ns = C.NormalizeScale(channels=4, scale=20.0)
+        v = ns.init(rng(), x)
+        y = ns.apply(v, x)
+        np.testing.assert_allclose(y, 20.0 / 2.0, rtol=1e-5)  # ||1,1,1,1||=2
+
+    def test_batchnorm_train_eval(self):
+        x = jax.random.normal(rng(), (8, 4)) * 3 + 1
+        bn = C.BatchNormalization()
+        v = bn.init(rng(), x, train=True)
+        y, mut = bn.apply(v, x, train=True, mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(y.mean(0)), 0.0, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(y.std(0)), 1.0, atol=1e-2)
+        # eval path uses running stats
+        y2 = bn.apply({"params": v["params"], **mut}, x, train=False)
+        assert y2.shape == x.shape
+
+    def test_lookup_table(self):
+        lt = C.LookupTable(vocab_size=10, embedding_dim=6)
+        ids = jnp.array([[1, 2], [3, 4]])
+        v = lt.init(rng(), ids)
+        assert lt.apply(v, ids).shape == (2, 2, 6)
+
+
+class TestRNN:
+    def test_recurrent_gru_shapes(self):
+        x = jnp.ones((2, 5, 3))
+        net = C.Recurrent(cell=C.GRUCell(hidden_size=7))
+        v = net.init(rng(), x)
+        assert net.apply(v, x).shape == (2, 5, 7)
+
+    def test_birecurrent_sum_concat(self):
+        x = jax.random.normal(rng(), (2, 5, 4))
+        for merge, d in [("sum", 6), ("concat", 12)]:
+            net = C.BiRecurrent(cell=C.GRUCell(hidden_size=6), merge=merge)
+            v = net.init(rng(), x)
+            assert net.apply(v, x).shape == (2, 5, d)
+
+    def test_rnn_identity_input(self):
+        # DS2 RnnCellDS: identity i2h, input width == hidden (RNN.scala:28)
+        x = jnp.ones((2, 4, 8))
+        net = C.Recurrent(cell=C.RnnCell(hidden_size=8, identity_input=True,
+                                         activation="clipped_relu"))
+        v = net.init(rng(), x)
+        y = net.apply(v, x)
+        assert y.shape == (2, 4, 8)
+        assert (np.asarray(y) <= 20.0).all()
+
+    def test_recurrent_reverse_equivalence(self):
+        x = jax.random.normal(rng(), (1, 6, 3))
+        net = C.Recurrent(cell=C.GRUCell(hidden_size=3), reverse=True)
+        v = net.init(rng(), x)
+        y = net.apply(v, x)
+        y2 = jnp.flip(
+            C.Recurrent(cell=C.GRUCell(hidden_size=3)).apply(v, jnp.flip(x, 1)), 1
+        )
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y2), rtol=1e-5)
+
+
+class TestCriterions:
+    def test_class_nll_matches_cross_entropy(self):
+        logits = jax.random.normal(rng(), (4, 5))
+        target = jnp.array([0, 1, 2, 3])
+        lsm = jax.nn.log_softmax(logits)
+        a = C.ClassNLLCriterion()(lsm, target)
+        b = C.CrossEntropyCriterion()(logits, target)
+        np.testing.assert_allclose(float(a), float(b), rtol=1e-6)
+
+    def test_bce(self):
+        p = jnp.array([0.9, 0.1])
+        t = jnp.array([1.0, 0.0])
+        val = float(C.BCECriterion()(p, t))
+        np.testing.assert_allclose(val, -np.log(0.9), rtol=1e-5)
+
+    def test_smooth_l1_golden(self):
+        # |d|<1 → 0.5 d^2 ; else |d|-0.5  (sigma=1)
+        d = jnp.array([0.5, 2.0])
+        out = C.SmoothL1Criterion(size_average=False)(d, jnp.zeros(2))
+        np.testing.assert_allclose(float(out), 0.5 * 0.25 + 1.5, rtol=1e-6)
+
+    def test_parallel_criterion(self):
+        pc = C.ParallelCriterion().add(C.MSECriterion(), 2.0).add(C.MSECriterion(), 1.0)
+        x = (jnp.ones(2), jnp.zeros(2))
+        t = (jnp.zeros(2), jnp.zeros(2))
+        np.testing.assert_allclose(float(pc(x, t)), 2.0)
+
+    def test_ctc_mask_semantics(self):
+        # mask=1 means VALID (framework convention); an all-ones mask must
+        # match passing no mask at all, not zero the loss out.
+        B, T, V, L = 2, 6, 5, 3
+        logits = jax.random.normal(rng(), (B, T, V))
+        labels = jnp.array([[1, 2, 3], [2, 1, 0]])
+        crit = C.CTCCriterion()
+        base = float(crit(logits, labels,
+                          label_mask=jnp.array([[1, 1, 1], [1, 1, 0]])))
+        masked = float(crit(logits, labels,
+                            logit_mask=jnp.ones((B, T)),
+                            label_mask=jnp.array([[1, 1, 1], [1, 1, 0]])))
+        np.testing.assert_allclose(base, masked, rtol=1e-6)
+        assert base > 0.1  # a real loss, not masked-to-zero
+
+    def test_parallel_criterion_arity_check(self):
+        pc = C.ParallelCriterion().add(C.MSECriterion())
+        with pytest.raises(ValueError):
+            pc((jnp.ones(2),), (jnp.zeros(2), jnp.zeros(2)))
+
+    def test_masked_reduce(self):
+        x = jnp.array([[1.0, 1.0], [5.0, 5.0]])
+        t = jnp.zeros((2, 2))
+        mask = jnp.array([[1.0, 1.0], [0.0, 0.0]])
+        np.testing.assert_allclose(float(C.MSECriterion()(x, t, mask=mask)), 1.0)
+
+
+class TestModelWrapper:
+    def test_model_forward_save_load(self, tmp_path):
+        net = C.Sequential([C.Linear(4), C.ReLU(), C.Linear(2)])
+        m = C.Model(net).build(0, jnp.ones((1, 3)))
+        x = jnp.ones((2, 3))
+        y = m.forward(x)
+        path = str(tmp_path / "model.bin")
+        m.save(path)
+        m2 = C.Model(net).build(1, jnp.ones((1, 3))).load(path)
+        np.testing.assert_allclose(np.asarray(m2.forward(x)), np.asarray(y), rtol=1e-6)
