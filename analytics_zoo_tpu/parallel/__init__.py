@@ -1,0 +1,48 @@
+"""Distributed runtime: mesh/sharding, jitted train loop, optim, checkpointing.
+
+The TPU-native replacement for the reference's BigDL DistriOptimizer + Spark
+distribution stack (SURVEY.md §2.7 "Optimizer" and §5 "Distributed
+communication backend").
+"""
+
+from analytics_zoo_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQUENCE_AXIS,
+    batch_sharding,
+    batch_spec,
+    create_mesh,
+    replicate,
+    replicated_sharding,
+    shard_batch,
+)
+from analytics_zoo_tpu.parallel.optim import (
+    SGD,
+    Adam,
+    AdamW,
+    OptimMethod,
+    Plateau,
+    TrainingState,
+    Trigger,
+    multistep,
+    polynomial,
+    warmup_linear,
+)
+from analytics_zoo_tpu.parallel.train import (
+    MAE,
+    Loss,
+    Optimizer,
+    Top1Accuracy,
+    TrainState,
+    ValidationMethod,
+    ValidationResult,
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+    state_to_variables,
+    validate,
+)
+from analytics_zoo_tpu.parallel.summary import TrainSummary, ValidationSummary
+from analytics_zoo_tpu.parallel import checkpoint
+
+__all__ = [k for k in dir() if not k.startswith("_")]

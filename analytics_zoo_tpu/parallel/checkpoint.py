@@ -1,0 +1,65 @@
+"""Checkpoint/resume via orbax — replaces BigDL's ``Module.save``/``load`` +
+``OptimMethod.load`` snapshot files (reference ``ssd/example/Train.scala:161-163``
+checkpoint path + ``optimizer.setCheckpoint(path, Trigger.everyEpoch)``).
+
+Layout: ``<path>/<step or 'latest'>/`` orbax PyTree checkpoint of the full
+``TrainState`` (params, model_state, opt_state, step, rng).  Multi-host
+safe: orbax coordinates a single logical checkpoint across processes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+def _checkpointer():
+    return ocp.PyTreeCheckpointer()
+
+
+def save(path: str, state: Any, step: Optional[int] = None) -> str:
+    """Save a pytree (TrainState or raw variables). ``step=None`` overwrites
+    a single 'latest' snapshot (reference ``overWriteCheckpoint``)."""
+    name = "latest" if step is None else f"step_{step}"
+    target = os.path.join(os.path.abspath(path), name)
+    host_state = jax.device_get(state)
+    _checkpointer().save(target, host_state, force=True)
+    return target
+
+
+def load(path: str, target: Any = None, step: Optional[int] = None) -> Any:
+    """Restore a checkpoint.  ``target`` (a matching pytree of arrays) fixes
+    leaf types/shapes; without it, raw arrays are returned.
+
+    ``step=None`` resolves to the 'latest' overwrite snapshot if present,
+    else the highest ``step_N`` directory, else treats ``path`` itself as
+    the checkpoint directory.
+    """
+    base = os.path.abspath(path)
+    if step is not None:
+        full = os.path.join(base, f"step_{step}")
+    elif os.path.exists(os.path.join(base, "latest")):
+        full = os.path.join(base, "latest")
+    else:
+        newest = latest_step(base)
+        full = os.path.join(base, f"step_{newest}") if newest is not None else base
+    if target is not None:
+        return _checkpointer().restore(full, item=target)
+    return _checkpointer().restore(full)
+
+
+def latest_step(path: str) -> Optional[int]:
+    if not os.path.isdir(path):
+        return None
+    steps = []
+    for d in os.listdir(path):
+        if d.startswith("step_"):
+            try:
+                steps.append(int(d.split("_", 1)[1]))
+            except ValueError:
+                pass
+    return max(steps) if steps else None

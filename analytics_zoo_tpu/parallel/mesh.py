@@ -1,0 +1,115 @@
+"""Device mesh + sharding helpers — the framework's distributed substrate.
+
+Replaces BigDL's Spark-executor topology (reference ``Engine.init`` +
+``ParameterManager`` AllReduce over the Spark block manager, SURVEY.md §2.7
+"Optimizer") with a ``jax.sharding.Mesh``.  Gradient synchronization is not
+an explicit AllReduce call anywhere in this codebase: batches are sharded
+over the ``data`` axis, parameters are replicated, and XLA inserts the
+``all-reduce`` over ICI when it compiles the jitted train step.
+
+Axis conventions (any subset may be size 1):
+  ``data``     — data parallel (batch dim)
+  ``model``    — tensor parallel (hidden dims)
+  ``sequence`` — sequence/context parallel (time dim; ring attention)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQUENCE_AXIS = "sequence"
+
+
+def create_mesh(
+    mesh_shape: Optional[Sequence[int]] = None,
+    axis_names: Sequence[str] = (DATA_AXIS,),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh over the available devices.
+
+    Default: 1-D pure data-parallel mesh over every device — the topology of
+    the reference's synchronous data-parallel DistriOptimizer.  Pass
+    ``mesh_shape=(dp, tp)`` + ``axis_names=("data", "model")`` etc. for
+    hybrid parallelism.  A ``-1`` dim is inferred like numpy reshape.
+    """
+    devices = list(devices) if devices is not None else list(jax.devices())
+    n = len(devices)
+    if mesh_shape is None:
+        mesh_shape = (n,) if len(axis_names) == 1 else None
+    if mesh_shape is None:
+        raise ValueError("mesh_shape required for multi-axis meshes")
+    shape = list(mesh_shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = n // known
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} does not cover {n} devices")
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def batch_spec(mesh: Mesh, ndim: int = 1) -> P:
+    """PartitionSpec sharding dim 0 over the data axis, rest replicated."""
+    axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
+    return P(axis, *([None] * (ndim - 1)))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """NamedSharding placing dim 0 of every batch leaf on the data axis."""
+    axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Place a host pytree of arrays onto the mesh, dim-0-sharded over
+    ``data`` (the per-iteration device feed of the train loop).
+
+    Scalars (0-d leaves) are replicated.  Dim 0 must divide the data-axis
+    size — use the data layer's ``drop_remainder``/padded batching for
+    ragged tails.
+    """
+    axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
+    n_shards = mesh.shape[axis]
+
+    def put(x):
+        x = np.asarray(x)
+        if x.ndim == 0:
+            return jax.device_put(x, NamedSharding(mesh, P()))
+        if x.shape[0] % n_shards:
+            raise ValueError(
+                f"batch dim {x.shape[0]} not divisible by data-axis size "
+                f"{n_shards}; pad the batch or drop the remainder "
+                f"(see data.batching drop_remainder)"
+            )
+        return jax.device_put(
+            x, NamedSharding(mesh, P(*([axis] + [None] * (x.ndim - 1))))
+        )
+
+    return jax.tree_util.tree_map(put, batch)
+
+
+def replicate(tree, mesh: Mesh):
+    """Replicate a pytree (params/opt state) across the whole mesh — the
+    one-time weight distribution that replaces the reference's per-job
+    ``ModelBroadcast`` (``common/Predictor.scala:36``)."""
+    return jax.device_put(tree, replicated_sharding(mesh))
+
+
+def local_data_slice(global_batch: int, mesh: Mesh) -> Tuple[int, int]:
+    """(start, size) of this host's slice of the global batch, so each host
+    feeds only its addressable shard (per-host file sharding)."""
+    n_proc = jax.process_count()
+    if global_batch % n_proc:
+        raise ValueError(f"global batch {global_batch} not divisible by {n_proc} hosts")
+    per = global_batch // n_proc
+    return jax.process_index() * per, per

@@ -1,0 +1,67 @@
+"""TensorBoard summaries — reference ``TrainSummary``/``ValidationSummary``
+(``ssd/example/Train.scala:237-243``; notebook
+``set_summary_trigger("Parameters", SeveralIteration(50))``).
+
+Backed by tensorboardX event files; per-tag triggers gate how often a tag is
+written.  Multi-host: only process 0 writes (metrics are already global
+since the loss/metrics come out of the psum'd step).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import jax
+
+from analytics_zoo_tpu.parallel.optim import TrainingState, Trigger
+
+
+class _Summary:
+    def __init__(self, log_dir: str, app_name: str, kind: str):
+        self.log_dir = os.path.join(log_dir, app_name, kind)
+        self._writer = None
+        self.triggers: Dict[str, Trigger] = {}
+
+    @property
+    def writer(self):
+        if self._writer is None and jax.process_index() == 0:
+            from tensorboardX import SummaryWriter
+
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._writer = SummaryWriter(self.log_dir)
+        return self._writer
+
+    def set_summary_trigger(self, tag: str, trigger: Trigger) -> "_Summary":
+        self.triggers[tag] = trigger
+        return self
+
+    def _gated(self, tag: str, iteration: int) -> bool:
+        t = self.triggers.get(tag)
+        if t is None:
+            return True
+        state = TrainingState(iteration=iteration)
+        return t(state)
+
+    def add_scalar(self, tag: str, value: float, iteration: int) -> None:
+        if self.writer is not None and self._gated(tag, iteration):
+            self.writer.add_scalar(tag, value, iteration)
+
+    def add_histogram(self, tag: str, values, iteration: int) -> None:
+        if self.writer is not None and self._gated(tag, iteration):
+            self.writer.add_histogram(tag, values, iteration)
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
+class TrainSummary(_Summary):
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "train")
+
+
+class ValidationSummary(_Summary):
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "validation")

@@ -1,0 +1,417 @@
+"""Train/eval step factories + the distributed Optimizer.
+
+This is the TPU-native replacement for BigDL's ``DistriOptimizer`` stack
+(reference ``Optimizer(model, trainSet, criterion).setOptimMethod
+.setValidation.setCheckpoint.setTrainSummary.setEndWhen.optimize()``,
+``ssd/example/Train.scala:219-252``).  Where BigDL runs a Spark job per
+iteration — executor model replicas, block-manager AllReduce, driver-side
+weight update — here the whole iteration is ONE jitted function: batches
+arrive sharded over the mesh's ``data`` axis, parameters are replicated, and
+XLA compiles the gradient mean into an ICI all-reduce.  There is no
+parameter server and no explicit communication code in the loss path.
+
+The host-side loop (this file's ``Optimizer.optimize``) only does what must
+stay on host: data feeding, triggers, validation, checkpointing, summaries,
+and metric-driven LR control (Plateau).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+
+from analytics_zoo_tpu.core.module import Model, accepted_kwargs
+from analytics_zoo_tpu.parallel import mesh as mesh_lib
+from analytics_zoo_tpu.parallel.optim import (
+    Adam,
+    OptimMethod,
+    TrainingState,
+    Trigger,
+)
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+
+class TrainState(struct.PyTreeNode):
+    """Everything the jitted step mutates, as one donated pytree."""
+
+    step: jax.Array
+    params: Any
+    model_state: Any          # batch_stats & friends (may be empty dict)
+    opt_state: Any
+    rng: jax.Array
+
+
+def create_train_state(model: Model, optim: OptimMethod, rng=0) -> TrainState:
+    if model.variables is None:
+        raise ValueError("model.build(...) before creating a train state")
+    if isinstance(rng, int):
+        rng = jax.random.PRNGKey(rng)
+    variables = dict(model.variables)
+    params = variables.pop("params")
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        model_state=variables,
+        opt_state=optim.tx.init(params),
+        rng=rng,
+    )
+
+
+def state_to_variables(state: TrainState):
+    return {"params": state.params, **state.model_state}
+
+
+def _forward(module, variables, inputs, train: bool, rngs=None, mutable=False):
+    args = inputs if isinstance(inputs, (tuple, list)) else (inputs,)
+    kwargs = accepted_kwargs(module, {"train": train})
+    if rngs:
+        kwargs["rngs"] = rngs
+    if mutable:
+        return module.apply(variables, *args, mutable=["batch_stats"], **kwargs)
+    return module.apply(variables, *args, **kwargs), None
+
+
+def _call_criterion(criterion, output, batch):
+    """Criterion protocol: ``crit(output, target)`` with optional ``mask``;
+    plain callables instead take ``(output, batch)`` for full control."""
+    from analytics_zoo_tpu.core.criterion import Criterion
+
+    if isinstance(criterion, Criterion):
+        target = batch.get("target")
+        if "target_mask" in batch:
+            return criterion(output, target, mask=batch["target_mask"])
+        return criterion(output, target)
+    return criterion(output, batch)
+
+
+def make_train_step(
+    module,
+    criterion: Callable,
+    optim: OptimMethod,
+    mesh=None,  # reserved for explicit in_shardings; batches arrive pre-sharded
+    loss_scale: float = 1.0,
+    grad_clip_norm: Optional[float] = None,
+    skip_loss_above: Optional[float] = None,
+):
+    """Build the jitted train step.
+
+    ``skip_loss_above`` reproduces MultiBoxLoss's gradient-explosion guard
+    (reference ``common/nn/MultiBoxLoss.scala:546``: skip backward when
+    loss > 50) — the update is zeroed when the loss exceeds the threshold,
+    as a lax.cond-free masked select so the step stays a single program.
+    """
+
+    def loss_fn(params, model_state, batch, rng):
+        variables = {"params": params, **model_state}
+        output, new_model_state = _forward(
+            module, variables, batch["input"], train=True,
+            rngs={"dropout": rng}, mutable=True,
+        )
+        loss = _call_criterion(criterion, output, batch)
+        return loss * loss_scale, (new_model_state, loss)
+
+    def step_fn(state: TrainState, batch, lr_scale):
+        rng, new_rng = jax.random.split(jax.random.fold_in(state.rng, state.step))
+        grads, (new_model_state, loss) = jax.grad(
+            loss_fn, has_aux=True
+        )(state.params, state.model_state, batch, rng)
+        if loss_scale != 1.0:
+            grads = jax.tree_util.tree_map(lambda g: g / loss_scale, grads)
+        gnorm = optax.global_norm(grads) if grad_clip_norm else None
+        if grad_clip_norm:
+            scale = jnp.minimum(1.0, grad_clip_norm / (gnorm + 1e-6))
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+        if skip_loss_above is not None:
+            keep = (loss <= skip_loss_above).astype(jnp.float32)
+            grads = jax.tree_util.tree_map(lambda g: g * keep, grads)
+        lr = optim.lr_for_step(state.step, lr_scale)
+        opt_state = _set_lr(state.opt_state, lr)
+        updates, new_opt_state = optim.tx.update(grads, opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, "lr": lr}
+        # merge: mutable apply only returns the batch_stats collection; any
+        # other collection in model_state must survive untouched
+        merged_model_state = {**state.model_state, **new_model_state}
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            model_state=merged_model_state,
+            opt_state=new_opt_state,
+            rng=new_rng,
+        )
+        return new_state, metrics
+
+    donate = (0,)
+    return jax.jit(step_fn, donate_argnums=donate)
+
+
+def _set_lr(opt_state, lr):
+    """Write the traced LR into optax's injected hyperparams slot."""
+    if hasattr(opt_state, "hyperparams"):
+        hp = dict(opt_state.hyperparams)
+        hp["learning_rate"] = lr
+        return opt_state._replace(hyperparams=hp)
+    return opt_state
+
+
+def make_eval_step(module):
+    """Jitted inference step: ``outputs = eval_step(variables, inputs)``."""
+
+    def eval_fn(variables, inputs):
+        out, _ = _forward(module, variables, inputs, train=False)
+        return out
+
+    return jax.jit(eval_fn)
+
+
+# ---------------------------------------------------------------------------
+# Validation methods (BigDL ValidationMethod monoid, SURVEY.md §2.7)
+# ---------------------------------------------------------------------------
+
+
+class ValidationResult:
+    """Mergeable (monoid) metric accumulator — reference
+    ``common/DetectionResult.scala:57`` ``+``-reduce across partitions."""
+
+    def __init__(self, value: float, count: float, name: str):
+        self.value = value
+        self.count = count
+        self.name = name
+
+    def __add__(self, other: "ValidationResult") -> "ValidationResult":
+        return ValidationResult(self.value + other.value, self.count + other.count,
+                                self.name)
+
+    def result(self) -> float:
+        return self.value / max(self.count, 1e-12)
+
+    def __repr__(self):
+        return f"{self.name}: {self.result():.6f} ({int(self.count)} samples)"
+
+
+class ValidationMethod:
+    name = "validation"
+
+    def __call__(self, output, batch) -> ValidationResult:  # pragma: no cover
+        raise NotImplementedError
+
+
+class Top1Accuracy(ValidationMethod):
+    name = "Top1Accuracy"
+
+    def __call__(self, output, batch):
+        target = np.asarray(batch["target"]).reshape(-1)
+        pred = np.asarray(jnp.argmax(output, axis=-1)).reshape(-1)
+        mask = np.asarray(batch.get("target_mask", np.ones_like(target))).reshape(-1)
+        correct = float(np.sum((pred == target) * mask))
+        return ValidationResult(correct, float(mask.sum()), self.name)
+
+
+class Loss(ValidationMethod):
+    name = "Loss"
+
+    def __init__(self, criterion):
+        self.criterion = criterion
+
+    def __call__(self, output, batch):
+        n = np.asarray(batch["target"]).shape[0]
+        loss = float(_call_criterion(self.criterion, output, batch))
+        return ValidationResult(loss * n, n, self.name)
+
+
+class MAE(ValidationMethod):
+    """Mean absolute error on the argmax class (the recommender notebook's
+    validation metric over 5 rating classes)."""
+
+    name = "MAE"
+
+    def __call__(self, output, batch):
+        target = np.asarray(batch["target"]).reshape(-1).astype(np.float32)
+        pred = np.asarray(jnp.argmax(output, axis=-1)).reshape(-1).astype(np.float32)
+        return ValidationResult(float(np.abs(pred - target).sum()), target.size,
+                                self.name)
+
+
+# ---------------------------------------------------------------------------
+# The Optimizer (host loop)
+# ---------------------------------------------------------------------------
+
+
+class Optimizer:
+    """BigDL-``Optimizer``-shaped trainer over a mesh.
+
+    Usage (mirrors ``ssd/example/Train.scala:219-252``)::
+
+        opt = (Optimizer(model, train_set, criterion, mesh=mesh)
+               .set_optim_method(SGD(lr, momentum=0.9, plateau=...))
+               .set_validation(Trigger.every_epoch(), val_set, [Top1Accuracy()])
+               .set_checkpoint(path, Trigger.every_epoch())
+               .set_train_summary(TrainSummary(logdir, app))
+               .set_end_when(Trigger.max_epoch(250)))
+        trained_model = opt.optimize()
+    """
+
+    def __init__(self, model: Model, dataset, criterion, mesh=None,
+                 skip_loss_above: Optional[float] = None,
+                 grad_clip_norm: Optional[float] = None):
+        self.model = model
+        self.dataset = dataset
+        self.criterion = criterion
+        self.mesh = mesh or mesh_lib.create_mesh()
+        self.optim: OptimMethod = Adam(1e-3)
+        self.end_when: Trigger = Trigger.max_epoch(1)
+        self.val_trigger: Optional[Trigger] = None
+        self.val_dataset = None
+        self.val_methods: Sequence[ValidationMethod] = ()
+        self.checkpoint_path: Optional[str] = None
+        self.checkpoint_trigger: Optional[Trigger] = None
+        self.overwrite_checkpoint = False
+        self.train_summary = None
+        self.val_summary = None
+        self.skip_loss_above = skip_loss_above
+        self.grad_clip_norm = grad_clip_norm
+        self._score_name: Optional[str] = None
+
+    # -- fluent config (reference API names, snake_cased) ------------------
+    def set_optim_method(self, m: OptimMethod) -> "Optimizer":
+        self.optim = m
+        return self
+
+    def set_end_when(self, t: Trigger) -> "Optimizer":
+        self.end_when = t
+        return self
+
+    def set_validation(self, trigger: Trigger, dataset,
+                       methods: Sequence[ValidationMethod],
+                       score_name: Optional[str] = None) -> "Optimizer":
+        self.val_trigger = trigger
+        self.val_dataset = dataset
+        self.val_methods = list(methods)
+        self._score_name = score_name or (methods[0].name if methods else None)
+        return self
+
+    def set_checkpoint(self, path: str, trigger: Trigger,
+                       overwrite: bool = True) -> "Optimizer":
+        self.checkpoint_path = path
+        self.checkpoint_trigger = trigger
+        self.overwrite_checkpoint = overwrite
+        return self
+
+    def set_train_summary(self, summary) -> "Optimizer":
+        self.train_summary = summary
+        return self
+
+    def set_validation_summary(self, summary) -> "Optimizer":
+        self.val_summary = summary
+        return self
+
+    # -- loop --------------------------------------------------------------
+    def optimize(self) -> Model:
+        state = create_train_state(self.model, self.optim)
+        state = mesh_lib.replicate(state, self.mesh)
+        train_step = make_train_step(
+            self.model.module, self.criterion, self.optim,
+            mesh=self.mesh, skip_loss_above=self.skip_loss_above,
+            grad_clip_norm=self.grad_clip_norm,
+        )
+        eval_step = make_eval_step(self.model.module)
+        loop = TrainingState()
+        t_epoch = time.time()
+        records = 0
+        stop = False
+        while not stop and not self.end_when(loop):
+            loop.epoch_finished = False
+            for batch in self.dataset:
+                n = _batch_size(batch)
+                dev_batch = mesh_lib.shard_batch(batch, self.mesh)
+                state, metrics = train_step(state, dev_batch, self.optim.lr_scale)
+                loop.iteration += 1
+                records += n
+                # keep the loss as a device array — only force a host sync
+                # when something host-side actually reads it
+                loop.loss = metrics["loss"]
+                if self.train_summary is not None:
+                    self.train_summary.add_scalar(
+                        "Loss", float(metrics["loss"]), loop.iteration)
+                    self.train_summary.add_scalar(
+                        "LearningRate", float(metrics["lr"]), loop.iteration)
+                self._maybe_validate(loop, state, eval_step)
+                self._maybe_checkpoint(loop, state)
+                if self.end_when(loop):
+                    stop = True
+                    break
+            if stop:
+                break  # partial epoch: don't count or re-trigger it
+            loop.epoch += 1
+            loop.epoch_finished = True
+            loop.loss = float(loop.loss)
+            dt = time.time() - t_epoch
+            logger.info("Epoch %d done: %d records in %.1fs (%.1f records/s), loss %.4f",
+                        loop.epoch, records, dt, records / max(dt, 1e-9), loop.loss)
+            t_epoch, records = time.time(), 0
+            self._maybe_validate(loop, state, eval_step)
+            self._maybe_checkpoint(loop, state)
+        # write trained variables back into the model wrapper
+        host_state = jax.device_get(state)
+        self.model.variables = state_to_variables(host_state)
+        self._last_state = host_state
+        return self.model
+
+    # -- helpers -----------------------------------------------------------
+    def _maybe_validate(self, loop: TrainingState, state: TrainState, eval_step):
+        if self.val_trigger is None or not self.val_trigger(loop):
+            return
+        # iteration-based triggers stay true at the epoch boundary; don't
+        # re-validate (and double-count toward Plateau patience) at the same
+        # iteration the in-loop pass already handled
+        if getattr(self, "_last_val_iter", None) == loop.iteration:
+            return
+        self._last_val_iter = loop.iteration
+        variables = state_to_variables(state)
+        results = validate(self.model.module, variables, self.val_dataset,
+                           self.val_methods, eval_step=eval_step)
+        metrics = {r.name: r.result() for r in results}
+        for name, value in metrics.items():
+            logger.info("Validation @ iter %d: %s = %.5f", loop.iteration, name, value)
+            if self.val_summary is not None:
+                self.val_summary.add_scalar(name, value, loop.iteration)
+        if self._score_name and self._score_name in metrics:
+            loop.score = metrics[self._score_name]
+            self.optim.on_validation({"score": loop.score, **metrics})
+
+    def _maybe_checkpoint(self, loop: TrainingState, state: TrainState):
+        if self.checkpoint_trigger is None or not self.checkpoint_trigger(loop):
+            return
+        if getattr(self, "_last_ckpt_iter", None) == loop.iteration:
+            return
+        self._last_ckpt_iter = loop.iteration
+        from analytics_zoo_tpu.parallel import checkpoint as ckpt
+        tag = None if self.overwrite_checkpoint else loop.iteration
+        ckpt.save(self.checkpoint_path, state, step=tag)
+
+
+def _batch_size(batch) -> int:
+    leaf = jax.tree_util.tree_leaves(batch)[0]
+    return int(np.asarray(leaf).shape[0])
+
+
+def validate(module, variables, dataset, methods: Sequence[ValidationMethod],
+             eval_step=None) -> List[ValidationResult]:
+    """Forward a dataset and monoid-reduce validation results (reference
+    ``Validator.test``, ``ssd/Validator.scala:59-86``)."""
+    eval_step = eval_step or make_eval_step(module)
+    totals: List[Optional[ValidationResult]] = [None] * len(methods)
+    for batch in dataset:
+        out = eval_step(variables, batch["input"])
+        for i, m in enumerate(methods):
+            r = m(out, batch)
+            totals[i] = r if totals[i] is None else totals[i] + r
+    return [t for t in totals if t is not None]
