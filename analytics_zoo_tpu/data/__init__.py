@@ -1,0 +1,31 @@
+"""Data layer: iterator transformers, datasets, record IO, batching, prefetch.
+
+TPU-native replacement for the reference's BigDL ``Transformer``/``DataSet``
+/ Hadoop-SequenceFile stack (SURVEY.md §2.2 "Dataset / IO", §2.7 "Data
+pipeline").
+"""
+
+from analytics_zoo_tpu.data.transformer import (
+    ChainedTransformer,
+    FnTransformer,
+    Pipeline,
+    RandomTransformer,
+    Transformer,
+)
+from analytics_zoo_tpu.data.dataset import (
+    Batcher,
+    DataSet,
+    default_collate,
+    pad_ragged,
+)
+from analytics_zoo_tpu.data.records import (
+    RecordWriter,
+    SSDByteRecord,
+    read_records,
+    read_ssd_records,
+    shard_paths,
+    write_ssd_records,
+)
+from analytics_zoo_tpu.data.prefetch import PrefetchDataSet, device_prefetch
+
+__all__ = [k for k in dir() if not k.startswith("_")]

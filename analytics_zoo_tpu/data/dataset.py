@@ -1,0 +1,160 @@
+"""DataSet: epoch-iterable sources + transform chains + batching.
+
+Replaces BigDL's ``DataSet.rdd(...) -> transformer chain`` (reference
+``ssd/Utils.scala:34-85``) with host-side Python iterators: a ``DataSet``
+wraps a re-invocable source, transformers attach with ``.transform`` (or
+``>>``), and ``iter(ds)`` yields one epoch.  Per-host input sharding
+replaces Spark partition placement; batches come out as numpy dicts ready
+for ``parallel.shard_batch`` / ``device_prefetch``.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.data import records as records_lib
+from analytics_zoo_tpu.data.transformer import Transformer
+
+
+class DataSet:
+    def __init__(self, source_fn: Callable[[], Iterator[Any]],
+                 size: Optional[int] = None):
+        self._source_fn = source_fn
+        self._size = size
+        self._stages: List[Transformer] = []
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def from_list(items: Sequence[Any], shuffle: bool = False,
+                  seed: int = 0) -> "DataSet":
+        items = list(items)
+        state = {"epoch": 0}
+
+        def source():
+            out = items
+            if shuffle:
+                out = list(items)
+                random.Random(seed + state["epoch"]).shuffle(out)
+                state["epoch"] += 1
+            return iter(out)
+
+        return DataSet(source, size=len(items))
+
+    @staticmethod
+    def from_record_files(pattern: str, decode_fn: Optional[Callable] = None,
+                          shard_by_host: bool = True,
+                          shuffle_files: bool = False, seed: int = 0) -> "DataSet":
+        """Sharded record-file source (the ``DataSet.rdd(sc.sequenceFile)``
+        equivalent, reference ``ssd/Utils.scala:37``)."""
+        if shard_by_host:
+            paths = records_lib.shard_paths(pattern)
+        else:
+            paths = records_lib.shard_paths(pattern, 0, 1)
+        state = {"epoch": 0}
+
+        def source():
+            order = list(paths)
+            if shuffle_files:
+                random.Random(seed + state["epoch"]).shuffle(order)
+                state["epoch"] += 1
+            for p in order:
+                for payload in records_lib.read_records(p):
+                    yield decode_fn(payload) if decode_fn else payload
+
+        return DataSet(source)
+
+    @staticmethod
+    def from_arrays(shuffle: bool = False, seed: int = 0, **arrays) -> "DataSet":
+        """Columnar in-memory source: yields per-sample dicts."""
+        n = len(next(iter(arrays.values())))
+        idx_state = {"epoch": 0}
+
+        def source():
+            idx = np.arange(n)
+            if shuffle:
+                np.random.RandomState(seed + idx_state["epoch"]).shuffle(idx)
+                idx_state["epoch"] += 1
+            for i in idx:
+                yield {k: v[i] for k, v in arrays.items()}
+
+        return DataSet(source, size=n)
+
+    # -- combinators -------------------------------------------------------
+    def transform(self, t: Transformer) -> "DataSet":
+        out = DataSet(self._source_fn, self._size)
+        out._stages = self._stages + [t]
+        return out
+
+    __rshift__ = transform
+
+    def batch(self, batch_size: int, collate_fn: Optional[Callable] = None,
+              drop_remainder: bool = True) -> "DataSet":
+        return self.transform(Batcher(batch_size, collate_fn, drop_remainder))
+
+    # -- iteration ---------------------------------------------------------
+    def __iter__(self) -> Iterator[Any]:
+        it = self._source_fn()
+        for stage in self._stages:
+            it = stage.apply_iter(iter(it))
+        return it
+
+    def __len__(self) -> int:
+        if self._size is None:
+            raise TypeError("DataSet size unknown (streaming source)")
+        return self._size
+
+
+# ---------------------------------------------------------------------------
+# Batching
+# ---------------------------------------------------------------------------
+
+
+def default_collate(samples: List[Any]) -> Any:
+    """Stack a list of samples: dicts stack per key, arrays stack on dim 0."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: default_collate([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(default_collate(list(col)) for col in zip(*samples))
+    if np.isscalar(first) or isinstance(first, np.ndarray):
+        return np.stack([np.asarray(s) for s in samples], axis=0)
+    return samples
+
+
+class Batcher(Transformer):
+    def __init__(self, batch_size: int, collate_fn: Optional[Callable] = None,
+                 drop_remainder: bool = True):
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or default_collate
+        self.drop_remainder = drop_remainder
+
+    def apply_iter(self, it: Iterator[Any]) -> Iterator[Any]:
+        buf: List[Any] = []
+        for sample in it:
+            buf.append(sample)
+            if len(buf) == self.batch_size:
+                yield self.collate_fn(buf)
+                buf = []
+        if buf and not self.drop_remainder:
+            yield self.collate_fn(buf)
+
+
+def pad_ragged(rows: List[np.ndarray], max_len: int,
+               pad_value: float = 0.0):
+    """Pad a list of (n_i, D) arrays to (B, max_len, D) + (B, max_len) mask —
+    the static-shape encoding of the reference's ragged 7-col ground-truth
+    matrix (``RoiImageToBatch.scala:86+``; SURVEY.md §7.3 "Ragged detection
+    labels")."""
+    D = rows[0].shape[1] if rows and rows[0].ndim == 2 else 1
+    B = len(rows)
+    out = np.full((B, max_len, D), pad_value, np.float32)
+    mask = np.zeros((B, max_len), np.float32)
+    for i, r in enumerate(rows):
+        r = np.asarray(r, np.float32).reshape(-1, D)
+        n = min(r.shape[0], max_len)
+        out[i, :n] = r[:n]
+        mask[i, :n] = 1.0
+    return out, mask

@@ -1,0 +1,83 @@
+"""Device prefetch: overlap host batch prep with device compute.
+
+The reference hides data-prep latency by caching transformed RDD partitions
+on executors (SURVEY.md §3.1 HOT LOOP #1); the TPU equivalent is a small
+host-side pipeline that device_puts the next batch(es) while the current
+step runs, double-buffering into HBM.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Iterator, Optional
+
+from analytics_zoo_tpu.parallel import mesh as mesh_lib
+
+
+def device_prefetch(batches: Iterable[Any], mesh, size: int = 2) -> Iterator[Any]:
+    """Yield device-resident, data-sharded batches, staying ``size`` ahead.
+
+    Early consumer exit (e.g. the train loop breaking on ``end_when``) is
+    handled: closing the generator signals the worker to stop, so no thread
+    is left blocked holding device buffers.
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    stop = object()
+    cancelled = threading.Event()
+    err: list = []
+
+    def worker():
+        try:
+            for b in batches:
+                item = mesh_lib.shard_batch(b, mesh)
+                while not cancelled.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if cancelled.is_set():
+                    return
+        except BaseException as e:  # propagate to consumer
+            err.append(e)
+        finally:
+            while True:
+                try:
+                    q.put_nowait(stop)
+                    break
+                except queue.Full:
+                    if cancelled.is_set():
+                        break
+                    try:
+                        q.get_nowait()
+                    except queue.Empty:
+                        pass
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is stop:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        cancelled.set()
+
+
+class PrefetchDataSet:
+    """Wrap a DataSet so every epoch iterates device-resident batches."""
+
+    def __init__(self, dataset, mesh, size: int = 2):
+        self.dataset = dataset
+        self.mesh = mesh
+        self.size = size
+
+    def __iter__(self):
+        return device_prefetch(iter(self.dataset), self.mesh, self.size)
+
+    def __len__(self):
+        return len(self.dataset)

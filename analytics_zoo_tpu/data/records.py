@@ -1,0 +1,142 @@
+"""Sharded binary record files — the Hadoop-SequenceFile replacement.
+
+The reference stores detection datasets as SequenceFiles of
+``SSDByteRecord`` blobs with layout ``[dataLen][classLen][jpeg bytes]
+[classes+difficult floats][bbox floats]`` written by ``RoiByteImageToSeq``
+(reference ``common/dataset/roiimage/*.scala``, SURVEY.md §2.2
+"Serialization format").  Here the container is a simple length-prefixed
+record file (``.azr``) designed for per-host sharding: shard k of N is the
+natural unit a TPU-VM host reads (`grain`/tf.data can also consume it via
+the generator API).
+
+File layout:  magic ``AZR1`` | then per record: u32 length | payload.
+``SSDByteRecord`` payload:  u32 path_len | path utf-8 | u32 img_len |
+jpeg/png bytes | u32 n_gt | n_gt × 6 float32 (label, difficult, x1,y1,x2,y2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob as globlib
+import os
+import struct
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+MAGIC = b"AZR1"
+
+
+# ---------------------------------------------------------------------------
+# Raw container
+# ---------------------------------------------------------------------------
+
+
+class RecordWriter:
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, "wb")
+        self._f.write(MAGIC)
+        self.count = 0
+
+    def write(self, payload: bytes) -> None:
+        self._f.write(struct.pack("<I", len(payload)))
+        self._f.write(payload)
+        self.count += 1
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_records(path: str) -> Iterator[bytes]:
+    with open(path, "rb") as f:
+        if f.read(4) != MAGIC:
+            raise ValueError(f"{path}: not an AZR1 record file")
+        while True:
+            head = f.read(4)
+            if len(head) < 4:
+                return
+            (n,) = struct.unpack("<I", head)
+            payload = f.read(n)
+            if len(payload) < n:
+                raise ValueError(f"{path}: truncated record")
+            yield payload
+
+
+def shard_paths(pattern: str, shard_index: Optional[int] = None,
+                num_shards: Optional[int] = None) -> List[str]:
+    """Deterministic per-host file sharding: host k takes files k, k+N, …
+    (replaces Spark's RDD partition placement for input files)."""
+    paths = sorted(globlib.glob(pattern)) if any(c in pattern for c in "*?[") \
+        else sorted(
+            os.path.join(pattern, p) for p in os.listdir(pattern)
+        ) if os.path.isdir(pattern) else [pattern]
+    if shard_index is None:
+        import jax
+        shard_index, num_shards = jax.process_index(), jax.process_count()
+    elif num_shards is None:
+        raise ValueError("num_shards required when shard_index is given")
+    return paths[shard_index::max(num_shards, 1)]
+
+
+# ---------------------------------------------------------------------------
+# SSDByteRecord
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SSDByteRecord:
+    """JPEG bytes + ground-truth matrix (reference ``SSDByteRecord``,
+    ``common/dataset/roiimage/Types.scala:31``).  ``gt`` rows are
+    (label, difficult, x1, y1, x2, y2) in pixel coords."""
+
+    data: bytes
+    path: str = ""
+    gt: Optional[np.ndarray] = None  # (N, 6) float32
+
+    def encode(self) -> bytes:
+        path_b = self.path.encode("utf-8")
+        gt = (np.zeros((0, 6), np.float32) if self.gt is None
+              else np.asarray(self.gt, np.float32).reshape(-1, 6))
+        return b"".join([
+            struct.pack("<I", len(path_b)), path_b,
+            struct.pack("<I", len(self.data)), self.data,
+            struct.pack("<I", gt.shape[0]), gt.tobytes(),
+        ])
+
+    @staticmethod
+    def decode(payload: bytes) -> "SSDByteRecord":
+        off = 0
+        (plen,) = struct.unpack_from("<I", payload, off); off += 4
+        path = payload[off:off + plen].decode("utf-8"); off += plen
+        (dlen,) = struct.unpack_from("<I", payload, off); off += 4
+        data = payload[off:off + dlen]; off += dlen
+        (n_gt,) = struct.unpack_from("<I", payload, off); off += 4
+        gt = np.frombuffer(payload, np.float32, n_gt * 6, off).reshape(n_gt, 6).copy()
+        return SSDByteRecord(data=data, path=path, gt=gt)
+
+
+def write_ssd_records(records: Sequence[SSDByteRecord], prefix: str,
+                      num_shards: int = 1) -> List[str]:
+    """Shard records round-robin into ``<prefix>-00000-of-0000N.azr``
+    (the ``RoiImageSeqGenerator`` equivalent, reference
+    ``common/dataset/RoiImageSeqGenerator.scala:25``)."""
+    paths = [f"{prefix}-{i:05d}-of-{num_shards:05d}.azr" for i in range(num_shards)]
+    writers = [RecordWriter(p) for p in paths]
+    for i, rec in enumerate(records):
+        writers[i % num_shards].write(rec.encode())
+    for w in writers:
+        w.close()
+    return paths
+
+
+def read_ssd_records(paths: Sequence[str]) -> Iterator[SSDByteRecord]:
+    for p in paths:
+        for payload in read_records(p):
+            yield SSDByteRecord.decode(payload)

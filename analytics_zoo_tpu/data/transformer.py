@@ -1,0 +1,88 @@
+"""Iterator transformers — the reference's core data-pipeline abstraction.
+
+BigDL's ``Transformer[A,B]`` is an ``Iterator[A] => Iterator[B]`` composed
+with ``->`` (reference ``transform/vision/.../image/Types.scala:167-217``,
+``ssd/Utils.scala:59-69``).  Here the same combinator algebra is plain
+Python: subclasses override ``transform`` (1→1), ``apply_iter`` (full
+stream), compose with ``>>`` (the ``->`` of the reference), and are cheaply
+``clone()``-able so parallel workers get independent RNG/scratch state
+(reference ``cloneTransformer``, ``common/Predictor.scala:82-86``).
+"""
+
+from __future__ import annotations
+
+import copy
+import random
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+
+
+class Transformer:
+    """Base: override ``transform(sample)`` or ``apply_iter(iterator)``."""
+
+    def transform(self, sample: Any) -> Any:
+        return sample
+
+    def apply_iter(self, it: Iterator[Any]) -> Iterator[Any]:
+        for sample in it:
+            out = self.transform(sample)
+            if out is not None:
+                yield out
+
+    def __call__(self, data: Iterable[Any]) -> Iterator[Any]:
+        return self.apply_iter(iter(data))
+
+    def __rshift__(self, other: "Transformer") -> "ChainedTransformer":
+        """``a >> b``: feed a's output stream into b (BigDL ``->``)."""
+        return ChainedTransformer(self, other)
+
+    def clone(self) -> "Transformer":
+        return copy.deepcopy(self)
+
+
+class ChainedTransformer(Transformer):
+    def __init__(self, *stages: Transformer):
+        flat = []
+        for s in stages:
+            if isinstance(s, ChainedTransformer):
+                flat.extend(s.stages)
+            else:
+                flat.append(s)
+        self.stages: Sequence[Transformer] = flat
+
+    def apply_iter(self, it: Iterator[Any]) -> Iterator[Any]:
+        for stage in self.stages:
+            it = stage.apply_iter(it)
+        return it
+
+
+class Pipeline(ChainedTransformer):
+    """List-style composition (the Python API's ``Pipeline([...])``,
+    reference ``transform/vision/src/main/python/image.py:26``)."""
+
+    def __init__(self, stages: Sequence[Transformer]):
+        super().__init__(*stages)
+
+
+class FnTransformer(Transformer):
+    def __init__(self, fn: Callable[[Any], Any]):
+        self.fn = fn
+
+    def transform(self, sample):
+        return self.fn(sample)
+
+
+class RandomTransformer(Transformer):
+    """Apply the wrapped transformer with probability ``prob`` (reference
+    ``RandomTransformer``, ``image/Types.scala:232`` — e.g.
+    ``Random(Expand -> RoiExpand, 0.5)`` in the SSD train chain)."""
+
+    def __init__(self, inner: Transformer, prob: float = 0.5,
+                 rng: Optional[random.Random] = None):
+        self.inner = inner
+        self.prob = prob
+        self.rng = rng or random.Random()
+
+    def transform(self, sample):
+        if self.rng.random() < self.prob:
+            return self.inner.transform(sample)
+        return sample

@@ -1,0 +1,30 @@
+"""Domain ops: detection math as jittable XLA programs.
+
+TPU-native re-implementation of the reference's custom NN op zoo
+(SURVEY.md §2.2 "Custom NN ops"): PriorBox, NMS, DetectionOutput,
+MultiBoxLoss, Anchor, Proposal, plus the BboxUtil linear algebra.
+"""
+
+from analytics_zoo_tpu.ops import bbox
+from analytics_zoo_tpu.ops.priorbox import (
+    PriorBoxParam,
+    concat_priors,
+    prior_box,
+)
+from analytics_zoo_tpu.ops.nms import nms
+from analytics_zoo_tpu.ops.detection_output import (
+    DetectionOutputParam,
+    detection_output,
+    detection_output_single,
+    scale_detections,
+)
+from analytics_zoo_tpu.ops.multibox_loss import (
+    MultiBoxLoss,
+    MultiBoxLossParam,
+    match_priors,
+    multibox_loss,
+)
+from analytics_zoo_tpu.ops.anchor import generate_base_anchors, shift_anchors
+from analytics_zoo_tpu.ops.proposal import ProposalParam, proposal
+
+__all__ = [k for k in dir() if not k.startswith("_")]

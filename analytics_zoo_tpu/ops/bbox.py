@@ -1,0 +1,158 @@
+"""Vectorized bounding-box math — jittable core of the detection stack.
+
+Replaces the reference's ``common/BboxUtil.scala`` (1019 LoC of sequential
+JVM loops: encode/decodeBBox ``:436,703,744``, bboxOverlap ``:203``,
+clipBoxes ``:575``, bboxVote ``:622``) with array programs: every function
+is shape-polymorphic over leading batch dims, jit/vmap-friendly, and uses
+masking instead of filtering so shapes stay static for XLA.
+
+Box convention: corner form ``(x1, y1, x2, y2)``; ``normalized=True`` means
+[0,1] image coordinates (no +1 width term), ``False`` means integer pixel
+boxes Caffe-style (+1 term) — both semantics of the reference's
+``normalized`` flag are kept.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def area(boxes: jax.Array, normalized: bool = True) -> jax.Array:
+    """(…, 4) → (…,) box areas; empty/invalid boxes give 0."""
+    off = 0.0 if normalized else 1.0
+    w = boxes[..., 2] - boxes[..., 0] + off
+    h = boxes[..., 3] - boxes[..., 1] + off
+    return jnp.where((w > 0) & (h > 0), w * h, 0.0)
+
+
+def intersection(a: jax.Array, b: jax.Array, normalized: bool = True) -> jax.Array:
+    """Pairwise intersection areas: a (N,4), b (M,4) → (N,M)."""
+    off = 0.0 if normalized else 1.0
+    x1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    y1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    x2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    y2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    w = jnp.maximum(x2 - x1 + off, 0.0)
+    h = jnp.maximum(y2 - y1 + off, 0.0)
+    return w * h
+
+
+def iou_matrix(a: jax.Array, b: jax.Array, normalized: bool = True) -> jax.Array:
+    """Pairwise IoU (reference ``BboxUtil.bboxOverlap:203`` /
+    ``jaccardOverlap``): a (N,4), b (M,4) → (N,M)."""
+    inter = intersection(a, b, normalized)
+    ua = area(a, normalized)[:, None] + area(b, normalized)[None, :] - inter
+    return jnp.where(ua > 0, inter / ua, 0.0)
+
+
+def center_size(boxes: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """corner → (cx, cy, w, h)."""
+    w = boxes[..., 2] - boxes[..., 0]
+    h = boxes[..., 3] - boxes[..., 1]
+    cx = boxes[..., 0] + w * 0.5
+    cy = boxes[..., 1] + h * 0.5
+    return cx, cy, w, h
+
+
+def encode_bbox(priors: jax.Array, variances: jax.Array,
+                gt: jax.Array) -> jax.Array:
+    """Caffe-SSD center-size encoding of gt boxes against priors
+    (reference ``BboxUtil.encodeBBox:436``): deltas divided by variances.
+
+    priors (…,4), variances (…,4), gt (…,4) → (…,4) encoded deltas.
+    """
+    pcx, pcy, pw, ph = center_size(priors)
+    gcx, gcy, gw, gh = center_size(gt)
+    pw = jnp.maximum(pw, 1e-8)
+    ph = jnp.maximum(ph, 1e-8)
+    ex = (gcx - pcx) / pw / variances[..., 0]
+    ey = (gcy - pcy) / ph / variances[..., 1]
+    ew = jnp.log(jnp.maximum(gw, 1e-8) / pw) / variances[..., 2]
+    eh = jnp.log(jnp.maximum(gh, 1e-8) / ph) / variances[..., 3]
+    return jnp.stack([ex, ey, ew, eh], axis=-1)
+
+
+def decode_bbox(priors: jax.Array, variances: jax.Array,
+                deltas: jax.Array, clip: bool = False) -> jax.Array:
+    """Inverse of :func:`encode_bbox` (reference ``BboxUtil.decodeBBox:703``):
+    apply predicted deltas to priors → corner-form boxes."""
+    pcx, pcy, pw, ph = center_size(priors)
+    cx = variances[..., 0] * deltas[..., 0] * pw + pcx
+    cy = variances[..., 1] * deltas[..., 1] * ph + pcy
+    w = jnp.exp(variances[..., 2] * deltas[..., 2]) * pw
+    h = jnp.exp(variances[..., 3] * deltas[..., 3]) * ph
+    boxes = jnp.stack([cx - w * 0.5, cy - h * 0.5, cx + w * 0.5, cy + h * 0.5],
+                      axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+def clip_boxes(boxes: jax.Array, height: float = 1.0,
+               width: float = 1.0) -> jax.Array:
+    """Clip corner boxes into the image (reference ``BboxUtil.clipBoxes:575``)."""
+    x1 = jnp.clip(boxes[..., 0], 0.0, width)
+    y1 = jnp.clip(boxes[..., 1], 0.0, height)
+    x2 = jnp.clip(boxes[..., 2], 0.0, width)
+    y2 = jnp.clip(boxes[..., 3], 0.0, height)
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+def scale_boxes(boxes: jax.Array, sx: jax.Array, sy: jax.Array) -> jax.Array:
+    """Scale x coords by sx, y by sy — normalized→pixel projection
+    (reference ``BboxUtil.scaleBatchOutput:384`` via imInfo)."""
+    return jnp.stack([
+        boxes[..., 0] * sx, boxes[..., 1] * sy,
+        boxes[..., 2] * sx, boxes[..., 3] * sy,
+    ], axis=-1)
+
+
+def bbox_transform(ex_rois: jax.Array, gt_rois: jax.Array) -> jax.Array:
+    """Faster-RCNN pixel-box regression targets (reference
+    ``BboxUtil.bboxTransform:290``; +1 widths, no variance scaling)."""
+    ew = ex_rois[..., 2] - ex_rois[..., 0] + 1.0
+    eh = ex_rois[..., 3] - ex_rois[..., 1] + 1.0
+    ecx = ex_rois[..., 0] + 0.5 * (ew - 1.0)
+    ecy = ex_rois[..., 1] + 0.5 * (eh - 1.0)
+    gw = gt_rois[..., 2] - gt_rois[..., 0] + 1.0
+    gh = gt_rois[..., 3] - gt_rois[..., 1] + 1.0
+    gcx = gt_rois[..., 0] + 0.5 * (gw - 1.0)
+    gcy = gt_rois[..., 1] + 0.5 * (gh - 1.0)
+    return jnp.stack([
+        (gcx - ecx) / ew, (gcy - ecy) / eh,
+        jnp.log(gw / ew), jnp.log(gh / eh),
+    ], axis=-1)
+
+
+def bbox_transform_inv(boxes: jax.Array, deltas: jax.Array) -> jax.Array:
+    """Apply Faster-RCNN deltas to pixel boxes (reference
+    ``BboxUtil.bboxTransformInv:520``)."""
+    w = boxes[..., 2] - boxes[..., 0] + 1.0
+    h = boxes[..., 3] - boxes[..., 1] + 1.0
+    cx = boxes[..., 0] + 0.5 * (w - 1.0)
+    cy = boxes[..., 1] + 0.5 * (h - 1.0)
+    ncx = deltas[..., 0] * w + cx
+    ncy = deltas[..., 1] * h + cy
+    nw = jnp.exp(deltas[..., 2]) * w
+    nh = jnp.exp(deltas[..., 3]) * h
+    return jnp.stack([
+        ncx - 0.5 * (nw - 1.0), ncy - 0.5 * (nh - 1.0),
+        ncx + 0.5 * (nw - 1.0), ncy + 0.5 * (nh - 1.0),
+    ], axis=-1)
+
+
+def bbox_vote(kept_boxes: jax.Array, kept_scores: jax.Array,
+              all_boxes: jax.Array, all_scores: jax.Array,
+              all_mask: jax.Array, iou_thresh: float = 0.5) -> jax.Array:
+    """Box voting (reference ``BboxUtil.bboxVote:622``): each kept box is
+    replaced by the score-weighted average of all candidate boxes whose IoU
+    with it exceeds ``iou_thresh``.  Masked, static shapes."""
+    iou = iou_matrix(kept_boxes, all_boxes, normalized=False)
+    w = jnp.where((iou >= iou_thresh) & (all_mask[None, :] > 0),
+                  all_scores[None, :], 0.0)
+    denom = jnp.maximum(jnp.sum(w, axis=1, keepdims=True), 1e-12)
+    voted = (w @ all_boxes) / denom
+    return jnp.where(jnp.sum(w, axis=1, keepdims=True) > 0, voted, kept_boxes)

@@ -1,0 +1,97 @@
+"""DetectionOutput: SSD serving-side post-processing, fully on device.
+
+Reference ``common/nn/DetectionOutput.scala:34`` (decode loc deltas vs
+priors → per-class confidence filter → per-class NMS topk 400 → global
+keep-topK 200) runs as a *layer inside the model graph*, so serving is one
+forward pass.  Same here: ``detection_output`` is jittable and is the last
+stage of the SSD model's ``apply``; per-class NMS is a ``vmap`` over the
+class axis and the global top-K is one ``lax.top_k`` — no host round-trip.
+
+Output layout per image: ``(keep_topk, 6)`` rows ``(class_id, score,
+x1, y1, x2, y2)``; empty slots have class_id = -1, score = 0 (static shape
+for XLA; the reference's variable-row output becomes mask-by-convention).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.ops.bbox import decode_bbox
+from analytics_zoo_tpu.ops.nms import nms
+
+
+@dataclasses.dataclass(frozen=True)
+class DetectionOutputParam:
+    """Reference ``PostProcessParam`` (``ssd/model/SSDGraph.scala:36``)."""
+
+    n_classes: int = 21
+    background_id: int = 0
+    conf_thresh: float = 0.01
+    nms_thresh: float = 0.45
+    nms_topk: int = 400
+    keep_topk: int = 200
+    share_location: bool = True
+    clip_boxes: bool = False
+
+
+def detection_output_single(loc: jax.Array, conf: jax.Array,
+                            priors: jax.Array, variances: jax.Array,
+                            param: DetectionOutputParam) -> jax.Array:
+    """One image: loc (P,4) deltas, conf (P,C) probabilities → (keep_topk, 6)."""
+    decoded = decode_bbox(priors, variances, loc, clip=param.clip_boxes)  # (P,4)
+
+    class_ids = jnp.arange(param.n_classes)
+    fg = class_ids != param.background_id  # (C,)
+
+    def per_class(scores):
+        return nms(decoded, scores, iou_threshold=param.nms_thresh,
+                   max_output=param.nms_topk, pre_topk=param.nms_topk,
+                   score_threshold=param.conf_thresh)
+
+    keep_idx, keep_mask = jax.vmap(per_class, in_axes=1)(conf)  # (C, nms_topk)
+    keep_mask = keep_mask * fg[:, None].astype(jnp.float32)
+
+    # flatten class×topk candidates, rank globally by score
+    flat_idx = keep_idx.reshape(-1)                       # (C·topk,)
+    flat_mask = keep_mask.reshape(-1)
+    flat_cls = jnp.repeat(class_ids, param.nms_topk)
+    safe_idx = jnp.maximum(flat_idx, 0)
+    flat_scores = conf[safe_idx, flat_cls] * flat_mask
+    top_scores, order = jax.lax.top_k(flat_scores, param.keep_topk)
+    top_cls = flat_cls[order]
+    top_boxes = decoded[safe_idx[order]]
+    valid = top_scores > 0
+    out = jnp.concatenate([
+        jnp.where(valid, top_cls, -1)[:, None].astype(jnp.float32),
+        top_scores[:, None],
+        jnp.where(valid[:, None], top_boxes, 0.0),
+    ], axis=1)
+    return out
+
+
+@partial(jax.jit, static_argnames=("param",))
+def detection_output(loc: jax.Array, conf: jax.Array, priors: jax.Array,
+                     variances: jax.Array,
+                     param: DetectionOutputParam = DetectionOutputParam()
+                     ) -> jax.Array:
+    """Batched: loc (B,P,4), conf (B,P,C) → (B, keep_topk, 6)."""
+    return jax.vmap(
+        lambda l, c: detection_output_single(l, c, priors, variances, param)
+    )(loc, conf)
+
+
+def scale_detections(dets: jax.Array, heights, widths) -> jax.Array:
+    """Project normalized detections to original pixel sizes (reference
+    ``BboxUtil.scaleBatchOutput:384`` using imInfo): dets (B,K,6)."""
+    h = jnp.asarray(heights).reshape(-1, 1)
+    w = jnp.asarray(widths).reshape(-1, 1)
+    return jnp.concatenate([
+        dets[..., :2],
+        dets[..., 2:3] * w, dets[..., 3:4] * h,
+        dets[..., 4:5] * w, dets[..., 5:6] * h,
+    ], axis=-1)

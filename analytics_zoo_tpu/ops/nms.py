@@ -1,0 +1,72 @@
+"""Greedy NMS as a static-shape XLA program.
+
+The reference's ``common/nn/Nms.scala:26`` is a sequential JVM loop with
+scratch buffers (``nms:66``, ``nmsFast:131`` with score threshold, topk and
+adaptive eta).  Greedy NMS is inherently sequential in its *selection*
+order, but each round's suppression is a vector op — so the TPU form is:
+
+1. ``lax.top_k`` down to ``pre_topk`` candidates (the reference's topk 400
+   pre-filter) — keeps the IoU matrix at pre_topk², not N²;
+2. one pre_topk×pre_topk IoU matrix (a single MXU-friendly batched op);
+3. a ``lax.fori_loop`` of ``max_output`` rounds: argmax → record → mask out
+   IoU ≥ thresh.  O(max_output · pre_topk) vector work, static shapes,
+   fully jittable and vmappable (per-class NMS = one vmap).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.ops.bbox import iou_matrix
+
+NEG_INF = -1e30
+
+
+@partial(jax.jit, static_argnames=("max_output", "pre_topk", "normalized"))
+def nms(boxes: jax.Array, scores: jax.Array, iou_threshold: float = 0.45,
+        max_output: int = 200, pre_topk: int = 400,
+        score_threshold: float = NEG_INF, eta: float = 1.0,
+        normalized: bool = True,
+        valid_mask: jax.Array = None) -> Tuple[jax.Array, jax.Array]:
+    """Greedy IoU suppression (reference ``Nms.nms``/``nmsFast`` semantics).
+
+    boxes (N,4), scores (N,) → (keep_idx (max_output,) int32 padded with -1,
+    keep_mask (max_output,) float32) — indices into the ORIGINAL N boxes.
+    ``eta`` reproduces nmsFast's adaptive threshold: after each kept box,
+    ``thresh *= eta`` while thresh > 0.5.
+    """
+    n = scores.shape[0]
+    active = jnp.where(scores > score_threshold, scores, NEG_INF)
+    if valid_mask is not None:
+        active = jnp.where(valid_mask > 0, active, NEG_INF)
+
+    k = min(pre_topk, n)
+    top_scores, top_idx = jax.lax.top_k(active, k)     # (k,)
+    top_boxes = boxes[top_idx]                          # (k,4)
+    iou = iou_matrix(top_boxes, top_boxes, normalized=normalized)  # (k,k)
+
+    def body(i, state):
+        act, keep_idx, keep_mask, thresh = state
+        best = jnp.argmax(act)
+        best_score = act[best]
+        ok = best_score > NEG_INF
+        keep_idx = keep_idx.at[i].set(jnp.where(ok, top_idx[best], -1))
+        keep_mask = keep_mask.at[i].set(ok.astype(jnp.float32))
+        suppress = (iou[best] >= thresh) | (jnp.arange(k) == best)
+        act = jnp.where(ok & suppress, NEG_INF, act)
+        new_thresh = jnp.where((eta < 1.0) & (thresh > 0.5), thresh * eta, thresh)
+        thresh = jnp.where(ok, new_thresh, thresh)
+        return act, keep_idx, keep_mask, thresh
+
+    keep_idx = jnp.full((max_output,), -1, jnp.int32)
+    keep_mask = jnp.zeros((max_output,), jnp.float32)
+    _, keep_idx, keep_mask, _ = jax.lax.fori_loop(
+        0, min(max_output, k), body,
+        (top_scores, keep_idx, keep_mask,
+         jnp.asarray(iou_threshold, jnp.float32)),
+    )
+    return keep_idx, keep_mask

@@ -1,0 +1,54 @@
+"""RPN Proposal layer (reference ``common/nn/Proposal.scala:33``):
+apply deltas to anchors, clip to image, drop boxes smaller than min_size,
+keep top-preNMS by score, NMS, keep top-postNMS.  Inference-only in the
+reference (``updateGradInput`` throws) and gradient-free here.
+
+Static-shape version: "filtering" is masking; outputs are padded to
+``post_nms_topn`` with a validity mask.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.ops.bbox import bbox_transform_inv, clip_boxes
+from analytics_zoo_tpu.ops.nms import nms
+
+
+@dataclasses.dataclass(frozen=True)
+class ProposalParam:
+    pre_nms_topn: int = 6000
+    post_nms_topn: int = 300
+    nms_thresh: float = 0.7
+    min_size: int = 16
+
+
+@partial(jax.jit, static_argnames=("param",))
+def proposal(scores: jax.Array, deltas: jax.Array, anchors: jax.Array,
+             im_height: jax.Array, im_width: jax.Array, scale: jax.Array,
+             param: ProposalParam = ProposalParam()
+             ) -> Tuple[jax.Array, jax.Array]:
+    """scores (N,) foreground probs, deltas (N,4), anchors (N,4) pixel boxes.
+
+    Returns (rois (post_nms_topn, 4), mask (post_nms_topn,)).
+    """
+    boxes = bbox_transform_inv(anchors, deltas)
+    boxes = clip_boxes(boxes, im_height - 1.0, im_width - 1.0)
+    ws = boxes[:, 2] - boxes[:, 0] + 1.0
+    hs = boxes[:, 3] - boxes[:, 1] + 1.0
+    min_sz = param.min_size * scale
+    keep = (ws >= min_sz) & (hs >= min_sz)
+    masked_scores = jnp.where(keep, scores, -jnp.inf)
+    keep_idx, keep_mask = nms(
+        boxes, masked_scores, iou_threshold=param.nms_thresh,
+        max_output=param.post_nms_topn,
+        pre_topk=min(param.pre_nms_topn, scores.shape[0]),
+        normalized=False,
+    )
+    rois = boxes[jnp.maximum(keep_idx, 0)] * keep_mask[:, None]
+    return rois, keep_mask

@@ -54,16 +54,19 @@ def create_mesh(
     return Mesh(dev_array, tuple(axis_names))
 
 
+def data_axis(mesh: Mesh) -> str:
+    """The mesh axis carrying the batch dim (``data`` if present)."""
+    return DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
+
+
 def batch_spec(mesh: Mesh, ndim: int = 1) -> P:
     """PartitionSpec sharding dim 0 over the data axis, rest replicated."""
-    axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
-    return P(axis, *([None] * (ndim - 1)))
+    return P(data_axis(mesh), *([None] * (ndim - 1)))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """NamedSharding placing dim 0 of every batch leaf on the data axis."""
-    axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
-    return NamedSharding(mesh, P(axis))
+    return NamedSharding(mesh, P(data_axis(mesh)))
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
@@ -78,7 +81,7 @@ def shard_batch(batch, mesh: Mesh):
     size — use the data layer's ``drop_remainder``/padded batching for
     ragged tails.
     """
-    axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
+    axis = data_axis(mesh)
     n_shards = mesh.shape[axis]
 
     def put(x):

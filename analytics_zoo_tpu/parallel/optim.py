@@ -166,6 +166,9 @@ class Adam(OptimMethod):
                  b2: float = 0.999, eps: float = 1e-8,
                  schedule: Optional[Callable] = None,
                  plateau: Optional[Plateau] = None):
+        if plateau is not None:
+            plateau.base_lr = learning_rate
+
         def factory():
             return _with_injected_lr(
                 lambda learning_rate: optax.adam(learning_rate, b1=b1, b2=b2, eps=eps)

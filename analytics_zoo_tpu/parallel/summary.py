@@ -40,7 +40,10 @@ class _Summary:
         t = self.triggers.get(tag)
         if t is None:
             return True
-        state = TrainingState(iteration=iteration)
+        # summary gating is iteration-granular (the reference's notebook use
+        # is SeveralIteration); epoch_finished=True keeps everyEpoch-style
+        # triggers from silently never firing here
+        state = TrainingState(iteration=iteration, epoch_finished=True)
         return t(state)
 
     def add_scalar(self, tag: str, value: float, iteration: int) -> None:

@@ -129,13 +129,21 @@ def make_train_step(
         if grad_clip_norm:
             scale = jnp.minimum(1.0, grad_clip_norm / (gnorm + 1e-6))
             grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
-        if skip_loss_above is not None:
-            keep = (loss <= skip_loss_above).astype(jnp.float32)
-            grads = jax.tree_util.tree_map(lambda g: g * keep, grads)
         lr = optim.lr_for_step(state.step, lr_scale)
         opt_state = _set_lr(state.opt_state, lr)
         updates, new_opt_state = optim.tx.update(grads, opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        if skip_loss_above is not None:
+            # reference guard (MultiBoxLoss.scala:546): a loss spike skips
+            # the ENTIRE update — params and optimizer state (momentum/Adam
+            # moments, counts) stay untouched, not just zeroed grads
+            keep = loss <= skip_loss_above
+            new_params = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(keep, new, old),
+                new_params, state.params)
+            new_opt_state = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(keep, new, old),
+                new_opt_state, opt_state)
         metrics = {"loss": loss, "lr": lr}
         # merge: mutable apply only returns the batch_stats collection; any
         # other collection in model_state must survive untouched

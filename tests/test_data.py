@@ -1,0 +1,123 @@
+"""Data-layer tests: transformer algebra, record IO, batching, prefetch."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.data import (
+    DataSet,
+    FnTransformer,
+    Pipeline,
+    RandomTransformer,
+    SSDByteRecord,
+    Transformer,
+    default_collate,
+    device_prefetch,
+    pad_ragged,
+    read_ssd_records,
+    shard_paths,
+    write_ssd_records,
+)
+from analytics_zoo_tpu.parallel import create_mesh
+
+
+def test_transformer_chaining():
+    double = FnTransformer(lambda x: x * 2)
+    inc = FnTransformer(lambda x: x + 1)
+    chain = double >> inc >> double
+    assert list(chain([1, 2, 3])) == [6, 10, 14]
+    # Pipeline form
+    assert list(Pipeline([double, inc])([1])) == [3]
+
+
+def test_transformer_drops_none():
+    class DropOdd(Transformer):
+        def transform(self, x):
+            return x if x % 2 == 0 else None
+
+    assert list(DropOdd()(range(6))) == [0, 2, 4]
+
+
+def test_random_transformer_prob():
+    import random
+    t = RandomTransformer(FnTransformer(lambda x: -x), prob=0.5,
+                          rng=random.Random(0))
+    out = list(t(list(range(1000))))
+    flipped = sum(1 for i, v in enumerate(out) if v == -i and i != 0)
+    assert 400 < flipped < 600
+
+
+def test_ssd_record_roundtrip(tmp_path):
+    recs = [
+        SSDByteRecord(data=bytes([i] * (10 + i)), path=f"img{i}.jpg",
+                      gt=np.arange(i * 6, dtype=np.float32).reshape(i, 6))
+        for i in range(5)
+    ]
+    paths = write_ssd_records(recs, str(tmp_path / "voc"), num_shards=2)
+    assert len(paths) == 2
+    back = list(read_ssd_records(sorted(paths)))
+    assert len(back) == 5
+    by_path = {r.path: r for r in back}
+    for r in recs:
+        b = by_path[r.path]
+        assert b.data == r.data
+        np.testing.assert_array_equal(b.gt, r.gt)
+
+
+def test_shard_paths(tmp_path):
+    files = []
+    for i in range(7):
+        p = tmp_path / f"f{i:02d}.azr"
+        p.write_bytes(b"AZR1")
+        files.append(str(p))
+    s0 = shard_paths(str(tmp_path / "*.azr"), 0, 2)
+    s1 = shard_paths(str(tmp_path / "*.azr"), 1, 2)
+    assert sorted(s0 + s1) == sorted(files)
+    assert len(s0) == 4 and len(s1) == 3
+
+
+def test_dataset_batching_and_epochs():
+    ds = (DataSet.from_arrays(x=np.arange(10, dtype=np.float32), shuffle=True)
+          .batch(4, drop_remainder=True))
+    e1 = [b["x"].tolist() for b in ds]
+    e2 = [b["x"].tolist() for b in ds]
+    assert len(e1) == 2 and all(len(b) == 4 for b in e1)
+    assert e1 != e2  # reshuffled between epochs
+    flat = sorted(v for b in e1 for v in b)
+    assert len(set(flat)) == 8
+
+
+def test_dataset_keep_remainder():
+    ds = DataSet.from_list(list(range(10))).batch(
+        4, collate_fn=lambda b: b, drop_remainder=False)
+    sizes = [len(b) for b in ds]
+    assert sizes == [4, 4, 2]
+
+
+def test_pad_ragged():
+    rows = [np.ones((2, 6)), np.zeros((0, 6)), np.full((5, 6), 3.0)]
+    out, mask = pad_ragged(rows, max_len=4)
+    assert out.shape == (3, 4, 6) and mask.shape == (3, 4)
+    assert mask.sum() == 2 + 0 + 4
+    assert (out[2, :4] == 3.0).all()
+
+
+def test_device_prefetch():
+    mesh = create_mesh()
+    batches = [{"x": np.ones((8, 3), np.float32) * i} for i in range(5)]
+    seen = list(device_prefetch(batches, mesh, size=2))
+    assert len(seen) == 5
+    assert float(seen[3]["x"][0, 0]) == 3.0
+    # error propagation
+    def bad():
+        yield batches[0]
+        raise RuntimeError("boom")
+    with pytest.raises(RuntimeError, match="boom"):
+        list(device_prefetch(bad(), mesh))
+
+
+def test_default_collate_nested():
+    samples = [{"a": np.ones(3), "b": (np.zeros(2), 1.0)} for _ in range(4)]
+    out = default_collate(samples)
+    assert out["a"].shape == (4, 3)
+    assert out["b"][0].shape == (4, 2)
+    assert out["b"][1].shape == (4,)
