@@ -54,6 +54,14 @@ class ChainedTransformer(Transformer):
             it = stage.apply_iter(it)
         return it
 
+    def transform(self, sample: Any) -> Any:
+        """Per-sample composition, so a chain can be wrapped by
+        RandomTransformer (e.g. ``Random(Expand >> RoiExpand, 0.5)`` in the
+        SSD train pipeline).  Only valid when every stage is 1→1."""
+        for stage in self.stages:
+            sample = stage.transform(sample)
+        return sample
+
 
 class Pipeline(ChainedTransformer):
     """List-style composition (the Python API's ``Pipeline([...])``,

@@ -1,0 +1,15 @@
+"""Model zoo: SSD detection, DeepSpeech2 ASR, and the app model families."""
+
+from analytics_zoo_tpu.models.ssd import (
+    SSDConfig,
+    SSDDetector,
+    SSDVgg,
+    build_priors,
+    num_priors_per_cell,
+    ssd300_config,
+    ssd512_config,
+)
+from analytics_zoo_tpu.models.deepspeech2 import DeepSpeech2, SequenceBN
+from analytics_zoo_tpu.models.simple import FraudMLP, NeuralCF, SentimentNet
+
+__all__ = [k for k in dir() if not k.startswith("_")]

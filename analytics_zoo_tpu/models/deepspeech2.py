@@ -1,0 +1,87 @@
+"""DeepSpeech2 acoustic model — scan-based BiRNN over mel features.
+
+Re-design of the reference's DS2 (serialized BigDL model + the extension
+layers in ``pipeline/deepspeech2/src/main/scala/com/intel/analytics/bigdl/
+nn/``: ``RnnCellDS`` with identity i2h, ``BiRecurrentDS`` sum-merged
+fwd/rev pair, ``BatchNormalizationDS`` sequence-wise BN,
+``BifurcateSplitTable``).  TPU-first choices:
+
+- time-major recurrence as a single ``lax.scan`` per direction (one
+  compiled body, weights broadcast — no per-step Python);
+- the reference's identity-i2h trick (input pre-projected by a shared
+  Linear, ``RNN.scala:28``) is kept: one big batched matmul projects the
+  whole sequence (MXU-friendly), then the scan applies only the h2h matmul
+  + clipped-ReLU;
+- sequence-wise BN ([B,T,D] stats over B·T, ``BatchNormalizationDS.scala:24``)
+  is a feature-axis BatchNorm here;
+- unlike the reference's inference-only batch-1 UDF (SURVEY.md §3.4 "batch
+  size 1!"), everything is batched and jittable; CTC training is supported
+  via ``core.criterion.CTCCriterion``.
+
+Geometry follows the DS2 paper / reference constants (13 mel filters in,
+conv front-end, 3 BiRNN layers, 29-char alphabet) except the hidden width,
+which defaults to 1024 (a TPU-friendly power of two; the reference's
+serialized model uses 1760 — pass ``hidden=1760`` for weight-import parity).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.core.rnn import BiRecurrent, RnnCell
+
+
+class SequenceBN(nn.Module):
+    """BN over (B·T) per feature (reference ``BatchNormalizationDS``)."""
+
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.BatchNorm(use_running_average=not train,
+                            momentum=self.momentum, epsilon=self.epsilon)(x)
+
+
+class DeepSpeech2(nn.Module):
+    """features (B, T, n_mels) → log-probs (B, T', n_alphabet).
+
+    ``n_alphabet`` defaults to the reference's 29-char alphabet
+    (``example/InferenceExample.scala:17-23``: blank + ' + A-Z + space),
+    blank at index 0 (``Decoder.scala``).
+    """
+
+    hidden: int = 1024
+    n_rnn_layers: int = 3
+    n_alphabet: int = 29
+    n_mels: int = 13
+    conv_channels: int = 32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B, T, F = x.shape
+        h = x[..., None]                                  # (B, T, F, 1)
+        # conv front-end: stride 2 in time halves T (DS2 conv1 11x13-ish
+        # receptive field adapted to the 13-mel input)
+        h = nn.Conv(self.conv_channels, (11, self.n_mels), strides=(2, 1),
+                    padding=((5, 5), (0, 0)), name="conv1")(h)
+        h = SequenceBN(name="bn_conv1")(h.reshape(B, h.shape[1], -1),
+                                        train=train)
+        h = jnp.clip(h, 0.0, 20.0)                        # clipped ReLU
+        for i in range(self.n_rnn_layers):
+            # per-layer input projection (the identity-i2h trick,
+            # ``RNN.scala:28``): one MXU matmul over the whole sequence,
+            # then the scan applies only the h2h recurrence
+            h = nn.Dense(self.hidden, name=f"proj{i}")(h)
+            h = SequenceBN(name=f"bn_rnn{i}")(h, train=train)
+            h = BiRecurrent(
+                cell=RnnCell(hidden_size=self.hidden, identity_input=True,
+                             activation="clipped_relu"),
+                merge="sum", name=f"birnn{i}")(h)
+        h = SequenceBN(name="bn_out")(h, train=train)
+        logits = nn.Dense(self.n_alphabet, name="fc_out")(h)
+        return jax.nn.log_softmax(logits, axis=-1)

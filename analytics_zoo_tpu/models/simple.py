@@ -1,0 +1,101 @@
+"""Small model families: fraud MLP, sentiment heads, neural CF recommender.
+
+Ports of the reference's app models:
+- fraud MLP  — ``fraudDetection/src/BigDLKaggleFraud.scala:37-39``:
+  ``Linear(29,10) → Linear(10,2) → LogSoftMax``.
+- sentiment  — ``apps/sentimentAnalysis/sentiment.ipynb``: GloVe embeddings
+  + selectable GRU / LSTM / BiLSTM / CNN / CNN-LSTM head → binary sigmoid.
+- NCF        — ``apps/recommendation/recommender-explicit-feedback.ipynb``:
+  user/item LookupTables → concat → MLP → LogSoftMax over 5 rating classes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.core.rnn import BiRecurrent, GRUCell, LSTMCell, Recurrent
+
+
+class FraudMLP(nn.Module):
+    """(B, 29) → (B, 2) log-probs."""
+
+    in_features: int = 29
+    hidden: int = 10
+    n_classes: int = 2
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(self.hidden, name="fc1")(x)
+        h = nn.Dense(self.n_classes, name="fc2")(h)
+        return jax.nn.log_softmax(h, axis=-1)
+
+
+class SentimentNet(nn.Module):
+    """token ids (B, T) → (B,) sigmoid probability.
+
+    ``head`` ∈ {"gru", "lstm", "bilstm", "cnn", "cnn-lstm"} — the notebook's
+    selectable architectures.  ``embeddings`` (vocab, dim) freezes GloVe
+    vectors when given; otherwise a trainable LookupTable is used.
+    """
+
+    vocab_size: int = 20000
+    embedding_dim: int = 100
+    hidden: int = 128
+    head: str = "gru"
+    embeddings: Optional[jnp.ndarray] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.embeddings is not None:
+            table = jnp.asarray(self.embeddings)
+            emb = table[x.astype(jnp.int32)]
+        else:
+            emb = nn.Embed(self.vocab_size, self.embedding_dim,
+                           name="embed")(x.astype(jnp.int32))
+        h = emb                                           # (B, T, D)
+        if self.head == "gru":
+            h = Recurrent(cell=GRUCell(hidden_size=self.hidden))(h)[:, -1]
+        elif self.head == "lstm":
+            h = Recurrent(cell=LSTMCell(hidden_size=self.hidden))(h)[:, -1]
+        elif self.head == "bilstm":
+            h = BiRecurrent(cell=LSTMCell(hidden_size=self.hidden),
+                            merge="concat")(h)[:, -1]
+        elif self.head in ("cnn", "cnn-lstm"):
+            h = nn.Conv(self.hidden, (5,), padding="SAME", name="conv")(h)
+            h = nn.relu(h)
+            if self.head == "cnn-lstm":
+                h = Recurrent(cell=LSTMCell(hidden_size=self.hidden))(h)[:, -1]
+            else:
+                h = jnp.max(h, axis=1)                    # global max pool
+        else:
+            raise ValueError(f"unknown head {self.head!r}")
+        h = nn.Dropout(0.2, deterministic=not train)(h)
+        h = nn.Dense(1, name="fc")(h)
+        return jax.nn.sigmoid(h)[..., 0]
+
+
+class NeuralCF(nn.Module):
+    """(user_ids (B,), item_ids (B,)) → (B, n_classes) log-probs."""
+
+    n_users: int = 1000
+    n_items: int = 1000
+    embedding_dim: int = 20
+    hidden: Sequence[int] = (40, 20)
+    n_classes: int = 5
+
+    @nn.compact
+    def __call__(self, inputs):
+        users, items = inputs
+        u = nn.Embed(self.n_users, self.embedding_dim, name="user_embed")(
+            users.astype(jnp.int32))
+        v = nn.Embed(self.n_items, self.embedding_dim, name="item_embed")(
+            items.astype(jnp.int32))
+        h = jnp.concatenate([u, v], axis=-1)
+        for i, width in enumerate(self.hidden):
+            h = nn.relu(nn.Dense(width, name=f"fc{i}")(h))
+        h = nn.Dense(self.n_classes, name="out")(h)
+        return jax.nn.log_softmax(h, axis=-1)

@@ -1,0 +1,1 @@
+"""Domain transform libraries (vision, audio) over the generic data layer."""

@@ -1,0 +1,33 @@
+"""Audio featurization + CTC decoding (the reference's acoustic pipeline)."""
+
+from analytics_zoo_tpu.transform.audio.featurize import (
+    N_MELS,
+    SAMPLE_RATE,
+    WINDOW_SIZE,
+    WINDOW_STRIDE,
+    TimeSegmenter,
+    dft_specgram,
+    featurize,
+    frame_signal,
+    mel_features,
+    mel_filterbank_matrix,
+    transpose_flip,
+)
+from analytics_zoo_tpu.transform.audio.decoders import (
+    ALPHABET,
+    BLANK_ID,
+    ASREvaluator,
+    NGramDecoder,
+    VocabDecoder,
+    best_path_decode,
+    cer,
+    levenshtein,
+    wer,
+)
+from analytics_zoo_tpu.transform.audio.readers import (
+    read_audio,
+    read_flac,
+    read_wav,
+)
+
+__all__ = [k for k in dir() if not k.startswith("_")]

@@ -1,0 +1,145 @@
+"""CTC decoders + ASR metrics.
+
+Port of the reference's decoder stack (``pipeline/deepspeech2/.../
+Decoder.scala:13,70`` greedy best-path, ``VocabDecoder.scala:37`` per-word
+vocab snap by edit distance, ``NGramDecoder.scala:36`` bigram rerank,
+``ArgMaxDecoder.scala:28`` alphabet) and the WER/CER evaluator
+(``ASREvaluator.scala:29,41-68``).
+
+Alphabet: 29 chars, blank at index 0 (reference ``InferenceExample.scala:
+17-23``): ``_'A-Z<space>``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+ALPHABET = "_'ABCDEFGHIJKLMNOPQRSTUVWXYZ "
+BLANK_ID = 0
+
+
+def best_path_decode(log_probs: np.ndarray, alphabet: str = ALPHABET,
+                     blank_id: int = BLANK_ID) -> str:
+    """Greedy CTC: per-frame argmax → collapse repeats → strip blanks
+    (reference ``BestPathDecoder``)."""
+    ids = np.asarray(log_probs).argmax(axis=-1)
+    out: List[str] = []
+    prev = -1
+    for i in ids:
+        if i != prev and i != blank_id:
+            out.append(alphabet[int(i)])
+        prev = i
+    return "".join(out)
+
+
+def levenshtein(a: Sequence, b: Sequence) -> int:
+    """Edit distance (reference ``ASREvaluator`` distance kernel)."""
+    if len(a) < len(b):
+        a, b = b, a
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        for j, cb in enumerate(b, 1):
+            cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                           prev[j - 1] + (ca != cb)))
+        prev = cur
+    return prev[-1]
+
+
+def wer(reference: str, hypothesis: str) -> float:
+    """Word error rate (reference ``ASREvaluator.scala:41``)."""
+    ref_words = reference.split()
+    if not ref_words:
+        return 0.0 if not hypothesis.split() else 1.0
+    return levenshtein(ref_words, hypothesis.split()) / len(ref_words)
+
+
+def cer(reference: str, hypothesis: str) -> float:
+    """Character error rate."""
+    if not reference:
+        return 0.0 if not hypothesis else 1.0
+    return levenshtein(reference, hypothesis) / len(reference)
+
+
+class VocabDecoder:
+    """Snap each decoded word to the nearest vocabulary word by edit
+    distance (reference ``VocabDecoder.scala:37``); words already in vocab
+    pass through."""
+
+    def __init__(self, vocab: Sequence[str], max_distance: int = 2):
+        self.vocab = [v.upper() for v in vocab]
+        self.vocab_set = set(self.vocab)
+        self.max_distance = max_distance
+
+    def decode_word(self, word: str) -> str:
+        if not word or word in self.vocab_set:
+            return word
+        best, best_d = word, self.max_distance + 1
+        for v in self.vocab:
+            d = levenshtein(word, v)
+            if d < best_d:
+                best, best_d = v, d
+        return best if best_d <= self.max_distance else word
+
+    def __call__(self, text: str) -> str:
+        return " ".join(self.decode_word(w) for w in text.split())
+
+
+class NGramDecoder:
+    """Bigram-context candidate rerank (reference ``NGramDecoder.scala:36``):
+    among near-vocab candidates for each word, prefer the one whose bigram
+    with the previous decoded word was seen in the corpus."""
+
+    def __init__(self, vocab: Sequence[str], bigrams: Sequence[Sequence[str]],
+                 max_distance: int = 2):
+        self.inner = VocabDecoder(vocab, max_distance)
+        self.bigrams = {(a.upper(), b.upper()) for a, b in bigrams}
+        self.max_distance = max_distance
+
+    def _candidates(self, word: str):
+        """(candidate, distance) pairs within max_distance — one vocab scan
+        reused for both the bigram pick and the fallback min-distance pick."""
+        cands = [(v, levenshtein(word, v)) for v in self.inner.vocab]
+        cands = [(v, d) for v, d in cands if d <= self.max_distance]
+        return cands or [(word, 0)]
+
+    def __call__(self, text: str) -> str:
+        out: List[str] = []
+        for w in text.split():
+            cands = self._candidates(w)
+            pick = None
+            if out:
+                for c, _ in cands:
+                    if (out[-1], c) in self.bigrams:
+                        pick = c
+                        break
+            if pick is None:
+                pick = min(cands, key=lambda cd: cd[1])[0]
+            out.append(pick)
+        return " ".join(out)
+
+
+class ASREvaluator:
+    """Accumulating WER/CER over utterances (reference ``ASREvaluator``)."""
+
+    def __init__(self):
+        self.word_errors = 0
+        self.words = 0
+        self.char_errors = 0
+        self.chars = 0
+
+    def add(self, reference: str, hypothesis: str) -> None:
+        self.word_errors += levenshtein(reference.split(), hypothesis.split())
+        self.words += len(reference.split())
+        self.char_errors += levenshtein(reference, hypothesis)
+        self.chars += len(reference)
+
+    @property
+    def wer(self) -> float:
+        return self.word_errors / max(self.words, 1)
+
+    @property
+    def cer(self) -> float:
+        return self.char_errors / max(self.chars, 1)

@@ -1,0 +1,56 @@
+"""Audio file readers: WAV (and FLAC when a decoder is available).
+
+Replaces the reference's ``FlacReader``/``WavReader`` Spark ML transformers
+(``acoustic/FlacReader.scala:38``, ``WavReader.scala:31``) with plain
+host-side functions returning float sample arrays at the pipeline's 16 kHz
+convention.  WAV decode uses the stdlib; FLAC is gated on an optional
+decoder (the reference bundled jflac — we avoid adding dependencies).
+"""
+
+from __future__ import annotations
+
+import wave
+from typing import Tuple
+
+import numpy as np
+
+
+def read_wav(path: str) -> Tuple[np.ndarray, int]:
+    """Decode a PCM WAV file → (float32 samples in [-1, 1], sample_rate)."""
+    with wave.open(path, "rb") as w:
+        rate = w.getframerate()
+        n = w.getnframes()
+        width = w.getsampwidth()
+        channels = w.getnchannels()
+        raw = w.readframes(n)
+    if width == 2:
+        data = np.frombuffer(raw, np.int16).astype(np.float32) / 32768.0
+    elif width == 1:
+        data = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128.0) / 128.0
+    elif width == 4:
+        data = np.frombuffer(raw, np.int32).astype(np.float32) / 2147483648.0
+    else:
+        raise ValueError(f"unsupported WAV sample width {width}")
+    if channels > 1:
+        data = data.reshape(-1, channels).mean(axis=1)
+    return data, rate
+
+
+def read_flac(path: str) -> Tuple[np.ndarray, int]:
+    """Decode FLAC via soundfile if present (reference used jflac)."""
+    try:
+        import soundfile  # optional dependency
+    except ImportError as e:
+        raise ImportError(
+            "FLAC decoding requires the optional 'soundfile' package; "
+            "convert to WAV or install soundfile") from e
+    data, rate = soundfile.read(path, dtype="float32")
+    if data.ndim > 1:
+        data = data.mean(axis=1)
+    return data.astype(np.float32), rate
+
+
+def read_audio(path: str) -> Tuple[np.ndarray, int]:
+    if path.lower().endswith(".flac"):
+        return read_flac(path)
+    return read_wav(path)

@@ -1,0 +1,50 @@
+"""Vision transform library — host-side image augmentation feeding the TPU.
+
+Port of the reference's standalone ``transform/vision`` module (SURVEY.md
+§2.1): ImageFeature/FeatureTransformer, color + geometric augmentations,
+ROI label co-transforms, and the SSD batch samplers.
+"""
+
+from analytics_zoo_tpu.transform.vision.image import (
+    FeatureTransformer,
+    ImageFeature,
+)
+from analytics_zoo_tpu.transform.vision.augmentation import (
+    AspectScale,
+    Brightness,
+    BytesToMat,
+    CenterCrop,
+    ChannelNormalize,
+    ChannelOrder,
+    ColorJitter,
+    Contrast,
+    Crop,
+    Expand,
+    Filler,
+    HFlip,
+    Hue,
+    MatToFloats,
+    PixelNormalizer,
+    RandomAspectScale,
+    RandomCrop,
+    Resize,
+    Saturation,
+)
+from analytics_zoo_tpu.transform.vision.roi import (
+    RoiCrop,
+    RoiExpand,
+    RoiHFlip,
+    RoiLabel,
+    RoiNormalize,
+    jaccard_overlap,
+    meet_emit_center_constraint,
+    project_bbox,
+)
+from analytics_zoo_tpu.transform.vision.sampler import (
+    BatchSampler,
+    RandomSampler,
+    generate_batch_samples,
+    standard_samplers,
+)
+
+__all__ = [k for k in dir() if not k.startswith("_")]

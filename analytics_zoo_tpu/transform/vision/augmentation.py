@@ -1,0 +1,396 @@
+"""Image augmentations: color + geometric ops over BGR numpy mats via OpenCV.
+
+Port of the reference's augmentation zoo (``transform/vision/.../image/
+augmentation/*.scala`` + ``Convertor.scala``) with identical knobs and
+random ranges.  These run on host CPU workers feeding the device (the
+reference runs them per-record inside Spark executors via OpenCV JNI —
+SURVEY.md §3.1 HOT LOOP #1); anything shape-static (normalize, layout) can
+instead be fused on-device at batch level.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Optional, Sequence, Tuple
+
+import cv2
+import numpy as np
+
+from analytics_zoo_tpu.data.transformer import RandomTransformer
+from analytics_zoo_tpu.transform.vision.image import FeatureTransformer, ImageFeature
+
+
+# ---------------------------------------------------------------------------
+# Decode / convert
+# ---------------------------------------------------------------------------
+
+
+class BytesToMat(FeatureTransformer):
+    """Decode jpg/png bytes → BGR mat, recording original dims (reference
+    ``Convertor.scala:24`` ``BytesToMat``); decode failure marks the
+    feature invalid (``:36-43``)."""
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        if not feature.is_valid:
+            return feature
+        try:
+            buf = np.frombuffer(feature["bytes"], np.uint8)
+            mat = cv2.imdecode(buf, cv2.IMREAD_COLOR)
+            if mat is None:
+                raise ValueError("imdecode failed")
+            feature.mat = mat.astype(np.float32)
+            feature["original_width"] = mat.shape[1]
+            feature["original_height"] = mat.shape[0]
+        except Exception:
+            feature.is_valid = False
+            feature.mat = None
+        return feature
+
+
+class MatToFloats(FeatureTransformer):
+    """mat → float array (+ optional per-channel mean subtract); invalid
+    features yield a zero array of the expected shape so batches stay
+    rectangular (reference ``Convertor.scala:54,74-84``)."""
+
+    def __init__(self, mean: Optional[Sequence[float]] = None,
+                 valid_height: int = 300, valid_width: int = 300):
+        super().__init__()
+        self.mean = None if mean is None else np.asarray(mean, np.float32)
+        self.valid_height = valid_height
+        self.valid_width = valid_width
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        if feature.is_valid and feature.mat is not None:
+            floats = feature.mat.astype(np.float32)
+            if self.mean is not None:
+                floats = floats - self.mean
+        else:
+            floats = np.zeros((self.valid_height, self.valid_width, 3), np.float32)
+        feature["floats"] = floats
+        return feature
+
+
+# ---------------------------------------------------------------------------
+# Color ops  (statics usable directly; transformer wrappers randomize)
+# ---------------------------------------------------------------------------
+
+
+class Brightness(FeatureTransformer):
+    """Add uniform delta ∈ [low, high] (reference ``Brightness.scala:27``;
+    Caffe convertTo beta)."""
+
+    def __init__(self, delta_low: float = -32.0, delta_high: float = 32.0):
+        super().__init__()
+        self.low, self.high = delta_low, delta_high
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        delta = random.uniform(self.low, self.high)
+        feature.mat = feature.mat.astype(np.float32) + delta
+
+
+class Contrast(FeatureTransformer):
+    """Scale by alpha ∈ [low, high] (reference ``Contrast.scala:23``)."""
+
+    def __init__(self, delta_low: float = 0.5, delta_high: float = 1.5):
+        super().__init__()
+        self.low, self.high = delta_low, delta_high
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        alpha = random.uniform(self.low, self.high)
+        feature.mat = feature.mat.astype(np.float32) * alpha
+
+
+def _to_hsv(mat: np.ndarray) -> np.ndarray:
+    return cv2.cvtColor(np.clip(mat, 0, 255).astype(np.uint8), cv2.COLOR_BGR2HSV)
+
+
+def _from_hsv(hsv: np.ndarray) -> np.ndarray:
+    return cv2.cvtColor(hsv, cv2.COLOR_HSV2BGR).astype(np.float32)
+
+
+class Saturation(FeatureTransformer):
+    """Scale the HSV S channel (reference ``Saturation.scala:30``)."""
+
+    def __init__(self, delta_low: float = 0.5, delta_high: float = 1.5):
+        super().__init__()
+        self.low, self.high = delta_low, delta_high
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        alpha = random.uniform(self.low, self.high)
+        if abs(alpha - 1.0) < 1e-3:
+            return
+        hsv = _to_hsv(feature.mat).astype(np.float32)
+        hsv[..., 1] = np.clip(hsv[..., 1] * alpha, 0, 255)
+        feature.mat = _from_hsv(hsv.astype(np.uint8))
+
+
+class Hue(FeatureTransformer):
+    """Shift the HSV H channel by delta ∈ [low, high] degrees (reference
+    ``Hue.scala:27``)."""
+
+    def __init__(self, delta_low: float = -18.0, delta_high: float = 18.0):
+        super().__init__()
+        self.low, self.high = delta_low, delta_high
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        delta = random.uniform(self.low, self.high)
+        hsv = _to_hsv(feature.mat).astype(np.float32)
+        # delta applies directly to OpenCV's [0,180) H channel, matching the
+        # reference's convertTo(..., 1, delta) on the HSV mat
+        hsv[..., 0] = np.mod(hsv[..., 0] + delta, 180.0)
+        feature.mat = _from_hsv(hsv.astype(np.uint8))
+
+
+class ChannelOrder(FeatureTransformer):
+    """Randomly permute the 3 channels (reference ``ChannelOrder.scala:28``)."""
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        perm = list(range(3))
+        random.shuffle(perm)
+        feature.mat = feature.mat[..., perm]
+
+
+class ChannelNormalize(FeatureTransformer):
+    """(x - mean) / std per channel (reference ``ChannelNormalize.scala:31``)."""
+
+    def __init__(self, mean: Sequence[float], std: Sequence[float] = (1, 1, 1)):
+        super().__init__()
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        feature.mat = (feature.mat.astype(np.float32) - self.mean) / self.std
+
+
+class PixelNormalizer(FeatureTransformer):
+    """Subtract a per-pixel mean image (reference ``PixelNormalizer.scala:28``)."""
+
+    def __init__(self, means: np.ndarray):
+        super().__init__()
+        self.means = means.astype(np.float32)
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        feature.mat = feature.mat.astype(np.float32) - self.means
+
+
+class ColorJitter(FeatureTransformer):
+    """Random-prob composition of brightness/contrast/saturation/hue/
+    channel-order in one of Caffe-SSD's two fixed orders, or fully shuffled
+    (reference ``ColorJitter.scala:38``)."""
+
+    def __init__(self, brightness_prob: float = 0.5, brightness_delta: float = 32,
+                 contrast_prob: float = 0.5, contrast_lower: float = 0.5,
+                 contrast_upper: float = 1.5, hue_prob: float = 0.5,
+                 hue_delta: float = 18, saturation_prob: float = 0.5,
+                 saturation_lower: float = 0.5, saturation_upper: float = 1.5,
+                 random_order_prob: float = 0.0, shuffle: bool = False):
+        super().__init__()
+        self.brightness = RandomTransformer(
+            Brightness(-brightness_delta, brightness_delta), brightness_prob)
+        self.contrast = RandomTransformer(
+            Contrast(contrast_lower, contrast_upper), contrast_prob)
+        self.saturation = RandomTransformer(
+            Saturation(saturation_lower, saturation_upper), saturation_prob)
+        self.hue = RandomTransformer(Hue(-hue_delta, hue_delta), hue_prob)
+        self.channel_order = RandomTransformer(ChannelOrder(), random_order_prob)
+        self.shuffle = shuffle
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        if not feature.is_valid:
+            return feature
+        order1 = [self.brightness, self.contrast, self.saturation, self.hue,
+                  self.channel_order]
+        order2 = [self.brightness, self.saturation, self.hue, self.contrast,
+                  self.channel_order]
+        ops = list(order1)
+        if self.shuffle:
+            random.shuffle(ops)
+        else:
+            ops = order1 if random.random() < 0.5 else order2
+        for op in ops:
+            feature = op.transform(feature)
+        return feature
+
+
+# ---------------------------------------------------------------------------
+# Geometric ops
+# ---------------------------------------------------------------------------
+
+_INTERP_MODES = [cv2.INTER_LINEAR, cv2.INTER_CUBIC, cv2.INTER_AREA,
+                 cv2.INTER_NEAREST, cv2.INTER_LANCZOS4]
+
+
+class Resize(FeatureTransformer):
+    """Resize to fixed (w, h); ``interp=-1`` picks a random mode per image
+    (reference ``Resize.scala:35,73`` — the SSD train chain uses random
+    interpolation)."""
+
+    def __init__(self, width: int, height: int, interp: int = cv2.INTER_LINEAR):
+        super().__init__()
+        self.width_, self.height_, self.interp = width, height, interp
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        interp = self.interp if self.interp >= 0 else random.choice(_INTERP_MODES)
+        feature.mat = cv2.resize(feature.mat, (self.width_, self.height_),
+                                 interpolation=interp)
+
+
+class AspectScale(FeatureTransformer):
+    """Scale the short side to ``min_size`` capped so the long side stays
+    ≤ ``max_size``, optionally rounding dims to a multiple (Faster-RCNN
+    style; reference ``Resize.scala:73`` AspectScale)."""
+
+    def __init__(self, min_size: int, scale_multiple_of: int = 1,
+                 max_size: int = 1000):
+        super().__init__()
+        self.min_size = min_size
+        self.scale_multiple_of = scale_multiple_of
+        self.max_size = max_size
+
+    def _scale(self, h: int, w: int) -> float:
+        short, long = min(h, w), max(h, w)
+        scale = self.min_size / short
+        if scale * long > self.max_size:
+            scale = self.max_size / long
+        return scale
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        h, w = feature.mat.shape[:2]
+        scale = self._scale(h, w)
+        nh, nw = int(round(h * scale)), int(round(w * scale))
+        if self.scale_multiple_of > 1:
+            m = self.scale_multiple_of
+            nh = int(np.ceil(nh / m) * m)
+            nw = int(np.ceil(nw / m) * m)
+        feature.mat = cv2.resize(feature.mat, (nw, nh))
+        feature["scale"] = scale
+
+
+class RandomAspectScale(AspectScale):
+    """AspectScale with min_size drawn from ``scales`` (reference
+    ``Resize.scala:118``)."""
+
+    def __init__(self, scales: Sequence[int], scale_multiple_of: int = 1,
+                 max_size: int = 1000):
+        super().__init__(scales[0], scale_multiple_of, max_size)
+        self.scales = list(scales)
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        self.min_size = random.choice(self.scales)
+        super().transform_mat(feature)
+
+
+class HFlip(FeatureTransformer):
+    """Horizontal mirror (reference ``HFlip.scala:23``)."""
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        feature.mat = cv2.flip(feature.mat, 1)
+
+
+class Expand(FeatureTransformer):
+    """Zoom-out: paste the image on a larger canvas filled with channel
+    means, recording the normalized expand bbox for label re-projection
+    (reference ``Expand.scala:28``)."""
+
+    def __init__(self, means: Sequence[float] = (123.0, 117.0, 104.0),
+                 max_expand_ratio: float = 4.0,
+                 min_expand_ratio: float = 1.0):
+        super().__init__()
+        self.means = np.asarray(means, np.float32)
+        self.min_ratio = min_expand_ratio
+        self.max_ratio = max_expand_ratio
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        ratio = random.uniform(self.min_ratio, self.max_ratio)
+        if ratio < 1.0 + 1e-6:
+            return
+        h, w = feature.mat.shape[:2]
+        nh, nw = int(h * ratio), int(w * ratio)
+        off_x = int(random.uniform(0, nw - w))
+        off_y = int(random.uniform(0, nh - h))
+        canvas = np.empty((nh, nw, 3), np.float32)
+        canvas[:] = self.means
+        canvas[off_y:off_y + h, off_x:off_x + w] = feature.mat
+        feature.mat = canvas
+        # normalized expand box of the original image inside the canvas
+        feature["expand_bbox"] = np.array(
+            [-off_x / w, -off_y / h, (nw - off_x) / w, (nh - off_y) / h],
+            np.float32)
+
+
+class Filler(FeatureTransformer):
+    """Fill a normalized rect with a constant (reference ``Filler.scala:31``)."""
+
+    def __init__(self, x1: float, y1: float, x2: float, y2: float,
+                 value: Sequence[float] = (255, 255, 255)):
+        super().__init__()
+        self.rect = (x1, y1, x2, y2)
+        self.value = np.asarray(value, np.float32)
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        h, w = feature.mat.shape[:2]
+        x1, y1, x2, y2 = self.rect
+        feature.mat[int(y1 * h):int(y2 * h), int(x1 * w):int(x2 * w)] = self.value
+
+
+class Crop(FeatureTransformer):
+    """Crop to a bbox from one of three sources (reference ``Crop.scala:26``):
+    a fixed normalized bbox, a feature key holding one, or a generator fn.
+    Records ``crop_bbox`` (normalized) for ROI re-projection."""
+
+    def __init__(self, bbox: Optional[Sequence[float]] = None,
+                 roi_key: Optional[str] = None,
+                 bbox_fn: Optional[Callable[[ImageFeature], Sequence[float]]] = None,
+                 normalized: bool = True):
+        super().__init__()
+        self.bbox = bbox
+        self.roi_key = roi_key
+        self.bbox_fn = bbox_fn
+        self.normalized = normalized
+
+    def _get_bbox(self, feature: ImageFeature):
+        if self.bbox is not None:
+            return self.bbox
+        if self.roi_key is not None:
+            return np.asarray(feature[self.roi_key], np.float32).reshape(-1)[:4]
+        return self.bbox_fn(feature)
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        h, w = feature.mat.shape[:2]
+        x1, y1, x2, y2 = [float(v) for v in self._get_bbox(feature)]
+        if self.normalized:
+            x1, x2 = x1 * w, x2 * w
+            y1, y2 = y1 * h, y2 * h
+        xi1, yi1 = max(int(round(x1)), 0), max(int(round(y1)), 0)
+        xi2, yi2 = min(int(round(x2)), w), min(int(round(y2)), h)
+        feature.mat = np.ascontiguousarray(feature.mat[yi1:yi2, xi1:xi2])
+        # record the CLIPPED box (reference Crop.scala clips before storing
+        # cropBbox) so RoiCrop projects labels into the actual pixel frame
+        feature["crop_bbox"] = np.array(
+            [xi1 / w, yi1 / h, xi2 / w, yi2 / h], np.float32)
+
+
+class CenterCrop(Crop):
+    """Centered fixed-size crop (reference ``Crop.scala:82``)."""
+
+    def __init__(self, crop_width: int, crop_height: int):
+        def center(feature: ImageFeature):
+            h, w = feature.mat.shape[:2]
+            x1 = (w - crop_width) / 2.0
+            y1 = (h - crop_height) / 2.0
+            return (x1, y1, x1 + crop_width, y1 + crop_height)
+
+        super().__init__(bbox_fn=center, normalized=False)
+
+
+class RandomCrop(Crop):
+    """Random fixed-size crop (reference ``Crop.scala:104``)."""
+
+    def __init__(self, crop_width: int, crop_height: int):
+        def rand(feature: ImageFeature):
+            h, w = feature.mat.shape[:2]
+            x1 = random.uniform(0, max(w - crop_width, 0))
+            y1 = random.uniform(0, max(h - crop_height, 0))
+            return (x1, y1, x1 + crop_width, y1 + crop_height)
+
+        super().__init__(bbox_fn=rand, normalized=False)

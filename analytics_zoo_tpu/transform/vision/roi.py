@@ -1,0 +1,180 @@
+"""ROI labels + label co-transforms: keep boxes consistent with image ops.
+
+Port of the reference's ``label/roi`` package: ``RoiLabel``
+(``label/roi/RoiLabel.scala:28``), the Roi co-transforms
+(``RoiTransformer.scala:25,35,62,76``) and the projection/constraint logic
+of ``AnnotationTransformer:109`` + ``util/BboxUtil.scala`` (host-side
+numpy — the device-side jax twin lives in ``analytics_zoo_tpu.ops.bbox``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.transform.vision.image import FeatureTransformer, ImageFeature
+
+
+@dataclasses.dataclass
+class RoiLabel:
+    """Per-image detection labels (reference ``RoiLabel``: a 2×N
+    [label; difficult] tensor + N×4 bboxes)."""
+
+    labels: np.ndarray      # (N,) float/int class ids
+    bboxes: np.ndarray      # (N, 4) corner boxes
+    difficult: Optional[np.ndarray] = None  # (N,) 0/1
+
+    def __post_init__(self):
+        self.labels = np.asarray(self.labels, np.float32).reshape(-1)
+        self.bboxes = np.asarray(self.bboxes, np.float32).reshape(-1, 4)
+        if self.difficult is None:
+            self.difficult = np.zeros_like(self.labels)
+        else:
+            self.difficult = np.asarray(self.difficult, np.float32).reshape(-1)
+
+    def size(self) -> int:
+        return int(self.labels.shape[0])
+
+    def select(self, keep: np.ndarray) -> "RoiLabel":
+        return RoiLabel(self.labels[keep], self.bboxes[keep],
+                        self.difficult[keep])
+
+    def to_gt_matrix(self) -> np.ndarray:
+        """(N, 6) rows (label, difficult, x1, y1, x2, y2) — the payload of
+        the reference's 7-col gt matrix minus the batch-index column, which
+        the padded batch layout replaces (SURVEY.md §7.3)."""
+        return np.concatenate([
+            self.labels[:, None], self.difficult[:, None], self.bboxes,
+        ], axis=1).astype(np.float32)
+
+    @staticmethod
+    def from_gt_matrix(m: np.ndarray) -> "RoiLabel":
+        m = np.asarray(m, np.float32).reshape(-1, 6)
+        return RoiLabel(m[:, 0], m[:, 2:6], m[:, 1])
+
+
+# ---------------------------------------------------------------------------
+# host-side bbox helpers (numpy mirrors of the Scala BboxUtil)
+# ---------------------------------------------------------------------------
+
+
+def jaccard_overlap(box: np.ndarray, boxes: np.ndarray) -> np.ndarray:
+    """IoU of one normalized box against (N,4) boxes (reference
+    ``util/BboxUtil.jaccardOverlap``)."""
+    x1 = np.maximum(box[0], boxes[:, 0])
+    y1 = np.maximum(box[1], boxes[:, 1])
+    x2 = np.minimum(box[2], boxes[:, 2])
+    y2 = np.minimum(box[3], boxes[:, 3])
+    inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    a = (box[2] - box[0]) * (box[3] - box[1])
+    b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    union = a + b - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+
+def meet_emit_center_constraint(src_box: np.ndarray,
+                                boxes: np.ndarray) -> np.ndarray:
+    """True where a gt box's center lies inside ``src_box`` (reference
+    ``BboxUtil.meetEmitCenterConstraint``)."""
+    cx = (boxes[:, 0] + boxes[:, 2]) / 2.0
+    cy = (boxes[:, 1] + boxes[:, 3]) / 2.0
+    return ((cx >= src_box[0]) & (cx <= src_box[2]) &
+            (cy >= src_box[1]) & (cy <= src_box[3]))
+
+
+def project_bbox(src_box: np.ndarray, boxes: np.ndarray
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Re-express normalized ``boxes`` in the frame of ``src_box``
+    (reference ``BboxUtil.projectBbox``): returns (projected (N,4) clipped
+    to [0,1], valid mask — projected boxes with positive area)."""
+    w = src_box[2] - src_box[0]
+    h = src_box[3] - src_box[1]
+    out = np.stack([
+        (boxes[:, 0] - src_box[0]) / w,
+        (boxes[:, 1] - src_box[1]) / h,
+        (boxes[:, 2] - src_box[0]) / w,
+        (boxes[:, 3] - src_box[1]) / h,
+    ], axis=1)
+    out = np.clip(out, 0.0, 1.0)
+    valid = (out[:, 2] > out[:, 0]) & (out[:, 3] > out[:, 1])
+    return out.astype(np.float32), valid
+
+
+# ---------------------------------------------------------------------------
+# co-transforms
+# ---------------------------------------------------------------------------
+
+
+class RoiNormalize(FeatureTransformer):
+    """Pixel gt boxes → [0,1] (reference ``RoiTransformer.scala:25``).
+    Writes a fresh RoiLabel — the caller's label object is never mutated,
+    so re-running a chain over retained features stays correct."""
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        label: RoiLabel = feature.label
+        h, w = feature.mat.shape[:2]
+        bboxes = label.bboxes.copy()
+        bboxes[:, 0::2] /= w
+        bboxes[:, 1::2] /= h
+        feature["label"] = RoiLabel(label.labels.copy(), bboxes,
+                                    label.difficult.copy())
+
+
+class RoiHFlip(FeatureTransformer):
+    """Mirror gt x coords; pairs with HFlip on the image (reference
+    ``RoiTransformer.scala:76``).  Non-mutating, like RoiNormalize."""
+
+    def __init__(self, normalized: bool = True):
+        super().__init__()
+        self.normalized = normalized
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        label: RoiLabel = feature.label
+        w = 1.0 if self.normalized else feature.mat.shape[1]
+        bboxes = label.bboxes.copy()
+        bboxes[:, 0] = w - label.bboxes[:, 2]
+        bboxes[:, 2] = w - label.bboxes[:, 0]
+        feature["label"] = RoiLabel(label.labels.copy(), bboxes,
+                                    label.difficult.copy())
+
+
+class RoiProject(FeatureTransformer):
+    """Shared logic of RoiCrop/RoiExpand (reference
+    ``AnnotationTransformer.transformAnnotation:109``): re-project gt into
+    the frame recorded by the paired image op, dropping boxes whose center
+    fell outside (emit-center constraint)."""
+
+    def __init__(self, bbox_key: str, emit_center: bool = True):
+        super().__init__()
+        self.bbox_key = bbox_key
+        self.emit_center = emit_center
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        if self.bbox_key not in feature:
+            return
+        src = np.asarray(feature[self.bbox_key], np.float32)
+        label: RoiLabel = feature.label
+        if label.size() == 0:
+            return
+        projected, valid = project_bbox(src, label.bboxes)
+        if self.emit_center:
+            valid &= meet_emit_center_constraint(src, label.bboxes)
+        new = label.select(valid)
+        new.bboxes = projected[valid]
+        feature["label"] = new
+
+
+class RoiCrop(RoiProject):
+    """Pairs with Crop (reference ``RoiTransformer.scala:35``)."""
+
+    def __init__(self):
+        super().__init__("crop_bbox", emit_center=True)
+
+
+class RoiExpand(RoiProject):
+    """Pairs with Expand (reference ``RoiTransformer.scala:62``)."""
+
+    def __init__(self):
+        super().__init__("expand_bbox", emit_center=False)

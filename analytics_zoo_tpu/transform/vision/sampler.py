@@ -1,0 +1,117 @@
+"""SSD batch samplers: constrained random crops for training.
+
+Port of the reference's ``label/roi/BatchSampler.scala:38`` /
+``RandomSampler.scala:26``: each sampler tries up to ``max_trials`` random
+boxes (scale ∈ [min_scale, max_scale], aspect ∈ [min_ar, max_ar]) and keeps
+those meeting its min/max-IoU constraint against the gt; ``RandomSampler``
+runs the 7 standard SSD samplers (no-constraint + IoU ≥ .1/.3/.5/.7/.9 +
+IoU ≤ 1.0), picks one sampled box at random, and applies Crop + RoiCrop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+from typing import List, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.transform.vision.augmentation import Crop
+from analytics_zoo_tpu.transform.vision.image import FeatureTransformer, ImageFeature
+from analytics_zoo_tpu.transform.vision.roi import RoiCrop, RoiLabel, jaccard_overlap
+
+
+@dataclasses.dataclass
+class BatchSampler:
+    """One constrained sampler (reference ``BatchSampler``)."""
+
+    max_sample: int = 1
+    max_trials: int = 50
+    min_scale: float = 0.3
+    max_scale: float = 1.0
+    min_aspect_ratio: float = 0.5
+    max_aspect_ratio: float = 2.0
+    min_overlap: Optional[float] = None
+    max_overlap: Optional[float] = None
+
+    def sample_box(self) -> np.ndarray:
+        scale = random.uniform(self.min_scale, self.max_scale)
+        min_ar = max(self.min_aspect_ratio, scale ** 2)
+        max_ar = min(self.max_aspect_ratio, 1.0 / (scale ** 2))
+        ar = random.uniform(min_ar, max_ar)
+        w = scale * math.sqrt(ar)
+        h = scale / math.sqrt(ar)
+        x1 = random.uniform(0.0, 1.0 - w)
+        y1 = random.uniform(0.0, 1.0 - h)
+        return np.array([x1, y1, x1 + w, y1 + h], np.float32)
+
+    def satisfies(self, box: np.ndarray, label: RoiLabel) -> bool:
+        if self.min_overlap is None and self.max_overlap is None:
+            return True
+        if label.size() == 0:
+            return False
+        ious = jaccard_overlap(box, label.bboxes)
+        best = float(ious.max())
+        if self.min_overlap is not None and best < self.min_overlap:
+            return False
+        if self.max_overlap is not None and best > self.max_overlap:
+            return False
+        return True
+
+    def sample(self, label: RoiLabel) -> List[np.ndarray]:
+        """Up to ``max_sample`` satisfying boxes in ``max_trials`` tries
+        (reference ``BatchSampler.sample:54``)."""
+        out: List[np.ndarray] = []
+        for _ in range(self.max_trials):
+            if len(out) >= self.max_sample:
+                break
+            box = self.sample_box()
+            if self.satisfies(box, label):
+                out.append(box)
+        return out
+
+
+def standard_samplers() -> List[BatchSampler]:
+    """The 7 SSD-paper samplers (reference ``RandomSampler.apply:58``)."""
+    samplers = [BatchSampler()]  # unconstrained whole-ish crop
+    for min_iou in (0.1, 0.3, 0.5, 0.7, 0.9):
+        samplers.append(BatchSampler(min_overlap=min_iou))
+    samplers.append(BatchSampler(max_overlap=1.0))
+    return samplers
+
+
+def generate_batch_samples(label: RoiLabel,
+                           samplers: Optional[List[BatchSampler]] = None
+                           ) -> List[np.ndarray]:
+    """All satisfying boxes from all samplers (reference
+    ``generateBatchSamples:113``)."""
+    samplers = samplers or standard_samplers()
+    boxes: List[np.ndarray] = []
+    for s in samplers:
+        boxes.extend(s.sample(label))
+    return boxes
+
+
+class RandomSampler(FeatureTransformer):
+    """Pick one sampled crop at random and apply it to image + labels
+    (reference ``RandomSampler.scala:26``).  No satisfying sample → image
+    passes through unchanged."""
+
+    def __init__(self, samplers: Optional[List[BatchSampler]] = None):
+        super().__init__()
+        self.samplers = samplers or standard_samplers()
+        self.roi_crop = RoiCrop()
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        if not feature.is_valid:
+            return feature
+        label = feature.label
+        if not isinstance(label, RoiLabel):
+            return feature
+        boxes = generate_batch_samples(label, self.samplers)
+        if not boxes:
+            return feature
+        box = boxes[random.randrange(len(boxes))]
+        feature = Crop(bbox=box.tolist(), normalized=True).transform(feature)
+        return self.roi_crop.transform(feature)

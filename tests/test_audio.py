@@ -1,0 +1,137 @@
+"""Audio pipeline tests: featurization golden properties, CTC decoding,
+WER/CER, segmentation, WAV IO."""
+
+import wave
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.transform.audio import (
+    ALPHABET,
+    ASREvaluator,
+    NGramDecoder,
+    TimeSegmenter,
+    VocabDecoder,
+    best_path_decode,
+    cer,
+    dft_specgram,
+    featurize,
+    frame_signal,
+    levenshtein,
+    mel_features,
+    mel_filterbank_matrix,
+    read_wav,
+    transpose_flip,
+    wer,
+)
+
+
+def test_frame_signal_counts():
+    frames = frame_signal(np.zeros(16000), 400, 160)
+    # (16000 - 400) / 160 + 1 = 98 frames ≈ reference's 100 frames/sec
+    assert frames.shape == (98, 400)
+
+
+def test_frame_signal_short_input():
+    assert frame_signal(np.zeros(100), 400, 160).shape == (0, 400)
+
+
+def test_dft_specgram_pure_tone():
+    t = np.arange(16000) / 16000.0
+    tone = np.sin(2 * np.pi * 1000 * t).astype(np.float32)
+    spec = dft_specgram(frame_signal(tone))
+    assert spec.shape == (98, 201)
+    # 1 kHz on a 400-sample window @16k -> bin 25
+    assert spec[5].argmax() == 25
+
+
+def test_mel_filterbank_shape_and_coverage():
+    fb = mel_filterbank_matrix(13, 400, 16000)
+    assert fb.shape == (201, 13)
+    assert (fb >= 0).all()
+    assert fb.sum() > 0
+    # each filter has some support
+    assert (fb.sum(axis=0) > 0).all()
+
+
+def test_featurize_shapes_and_padding():
+    samples = np.random.RandomState(0).randn(16000).astype(np.float32)
+    mel = featurize(samples, utt_length=150)
+    assert mel.shape == (150, 13)
+    # 98 real frames then zero-pad
+    assert not (mel[:98] == 0).all()
+    assert (mel[98:] == 0).all()
+    cropped = featurize(samples, utt_length=50)
+    assert cropped.shape == (50, 13)
+
+
+def test_transpose_flip_range_and_layout():
+    mel = np.random.RandomState(1).randn(98, 13).astype(np.float32)
+    out = transpose_flip(mel)
+    assert out.shape == (13, 98)
+    assert out.min() == pytest.approx(0.0)
+    assert out.max() == pytest.approx(255.0)
+
+
+def test_time_segmenter():
+    seg = TimeSegmenter(segment_size=1000)
+    chunks = seg.segment(np.arange(2500, dtype=np.float32), "utt1")
+    assert [c["audio_seq"] for c in chunks] == [0, 1, 2]
+    assert [len(c["samples"]) for c in chunks] == [1000, 1000, 500]
+    joined = np.concatenate([c["samples"] for c in chunks])
+    np.testing.assert_array_equal(joined, np.arange(2500, dtype=np.float32))
+
+
+def test_best_path_decode():
+    # logits favoring: H H _ E _ L L L _ L O  -> "HELLO"
+    def one_hot(ids, n=29):
+        out = np.full((len(ids), n), -10.0, np.float32)
+        for i, k in enumerate(ids):
+            out[i, k] = 0.0
+        return out
+
+    H, E, L, O = (ALPHABET.index(c) for c in "HELO")
+    ids = [H, H, 0, E, 0, L, L, L, 0, L, O]
+    assert best_path_decode(one_hot(ids)) == "HELLO"
+
+
+def test_levenshtein_and_rates():
+    assert levenshtein("kitten", "sitting") == 3
+    assert wer("the cat sat", "the cat sat") == 0.0
+    assert wer("the cat sat", "the bat sat") == pytest.approx(1 / 3)
+    assert cer("abc", "abd") == pytest.approx(1 / 3)
+
+
+def test_vocab_decoder():
+    d = VocabDecoder(["HELLO", "WORLD"], max_distance=2)
+    assert d("HELO WORLD") == "HELLO WORLD"
+    assert d("ZZZZZZ") == "ZZZZZZ"  # too far from vocab -> unchanged
+
+
+def test_ngram_decoder_prefers_bigram():
+    d = NGramDecoder(["NEW", "YORK", "YOLK"], [("NEW", "YORK")])
+    # 'YORK' and 'YOLK' both distance 1 from 'YORE'; bigram (NEW, YORK) wins
+    assert d("NEW YORE") == "NEW YORK"
+
+
+def test_asr_evaluator_accumulates():
+    ev = ASREvaluator()
+    ev.add("the cat", "the cat")
+    ev.add("a dog ran", "a dog run")
+    assert ev.wer == pytest.approx(1 / 5)
+    assert ev.cer > 0
+
+
+def test_read_wav_roundtrip(tmp_path):
+    path = str(tmp_path / "t.wav")
+    rate = 16000
+    samples = (np.sin(np.linspace(0, 100, rate)) * 20000).astype(np.int16)
+    with wave.open(path, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes(samples.tobytes())
+    data, r = read_wav(path)
+    assert r == rate
+    assert data.shape == (rate,)
+    np.testing.assert_allclose(data, samples / 32768.0, atol=1e-6)

@@ -1,0 +1,128 @@
+"""Model zoo tests: shapes, prior counts, gradient flow, detector output."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from analytics_zoo_tpu.models import (
+    DeepSpeech2,
+    FraudMLP,
+    NeuralCF,
+    SSDDetector,
+    SSDVgg,
+    SentimentNet,
+    build_priors,
+    num_priors_per_cell,
+    ssd300_config,
+    ssd512_config,
+)
+
+
+def test_ssd300_prior_count():
+    cfg = ssd300_config()
+    per_cell = num_priors_per_cell(cfg)
+    assert per_cell == [4, 6, 6, 6, 4, 4]
+    priors, variances = build_priors(cfg)
+    # the canonical SSD300 prior count
+    assert priors.shape == (8732, 4)
+    assert variances.shape == (8732, 4)
+
+
+def test_ssd512_prior_count():
+    cfg = ssd512_config()
+    per_cell = num_priors_per_cell(cfg)
+    assert per_cell == [4, 6, 6, 6, 6, 4, 4]
+    priors, _ = build_priors(cfg)
+    expected = sum(k * f * f for k, f in zip(per_cell, cfg.feature_shapes))
+    assert priors.shape == (expected, 4)
+    assert expected == 24564
+
+
+def test_ssd300_forward_shapes():
+    model = SSDVgg(num_classes=21, resolution=300)
+    x = jnp.zeros((1, 300, 300, 3))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    loc, conf = model.apply(variables, x)
+    assert loc.shape == (1, 8732, 4)
+    assert conf.shape == (1, 8732, 21)
+
+
+def test_ssd300_grad_flows():
+    model = SSDVgg(num_classes=4, resolution=300)
+    x = jnp.ones((1, 300, 300, 3)) * 0.1
+    variables = model.init(jax.random.PRNGKey(0), x)
+
+    def loss(params):
+        loc, conf = model.apply({"params": params}, x)
+        return jnp.sum(loc ** 2) + jnp.sum(conf ** 2)
+
+    g = jax.grad(loss)(variables["params"])
+    leaves = jax.tree_util.tree_leaves(g)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+    total = sum(float(jnp.abs(l).sum()) for l in leaves)
+    assert total > 0
+
+
+def test_ssd_detector_output_shape():
+    model = SSDDetector(num_classes=21, resolution=300)
+    x = jnp.zeros((2, 300, 300, 3))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    dets = model.apply(variables, x)
+    assert dets.shape == (2, 200, 6)
+
+
+def test_deepspeech2_shapes_and_grad():
+    model = DeepSpeech2(hidden=64, n_rnn_layers=2)
+    x = jnp.zeros((2, 50, 13))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(variables, x)
+    assert out.shape == (2, 25, 29)       # stride-2 conv halves T
+    # log-softmax rows sum to 1 in prob space
+    np.testing.assert_allclose(np.exp(np.asarray(out)).sum(-1), 1.0, atol=1e-4)
+
+    def loss(params):
+        return jnp.sum(model.apply({"params": params,
+                                    "batch_stats": variables["batch_stats"]},
+                                   x) ** 2)
+
+    g = jax.grad(loss)(variables["params"])
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree_util.tree_leaves(g))
+
+
+def test_fraud_mlp():
+    m = FraudMLP()
+    x = jnp.zeros((4, 29))
+    v = m.init(jax.random.PRNGKey(0), x)
+    out = m.apply(v, x)
+    assert out.shape == (4, 2)
+    np.testing.assert_allclose(np.exp(np.asarray(out)).sum(-1), 1.0, atol=1e-5)
+
+
+@pytest.mark.parametrize("head", ["gru", "lstm", "bilstm", "cnn", "cnn-lstm"])
+def test_sentiment_heads(head):
+    m = SentimentNet(vocab_size=100, embedding_dim=16, hidden=8, head=head)
+    x = jnp.ones((2, 12), jnp.int32)
+    v = m.init(jax.random.PRNGKey(0), x)
+    out = m.apply(v, x)
+    assert out.shape == (2,)
+    assert ((np.asarray(out) >= 0) & (np.asarray(out) <= 1)).all()
+
+
+def test_sentiment_frozen_glove():
+    table = np.random.RandomState(0).randn(50, 8).astype(np.float32)
+    m = SentimentNet(embeddings=table, hidden=8, head="cnn")
+    x = jnp.ones((2, 5), jnp.int32)
+    v = m.init(jax.random.PRNGKey(0), x)
+    # no trainable embedding table in params
+    assert "embed" not in v["params"]
+
+
+def test_neural_cf():
+    m = NeuralCF(n_users=30, n_items=40)
+    u = jnp.array([1, 2, 3])
+    i = jnp.array([4, 5, 6])
+    v = m.init(jax.random.PRNGKey(0), (u, i))
+    out = m.apply(v, (u, i))
+    assert out.shape == (3, 5)

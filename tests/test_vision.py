@@ -1,0 +1,310 @@
+"""Vision transform tests — mirrors the reference's FeatureTransformerSpec
+(one case per op, SSD chain, corrupt-input survival) and BatchSamplerSpec.
+"""
+
+import random
+
+import cv2
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.data import RandomTransformer
+from analytics_zoo_tpu.transform.vision import (
+    AspectScale,
+    BatchSampler,
+    Brightness,
+    BytesToMat,
+    CenterCrop,
+    ChannelNormalize,
+    ChannelOrder,
+    ColorJitter,
+    Contrast,
+    Crop,
+    Expand,
+    FeatureTransformer,
+    HFlip,
+    Hue,
+    ImageFeature,
+    MatToFloats,
+    RandomCrop,
+    RandomSampler,
+    Resize,
+    RoiCrop,
+    RoiExpand,
+    RoiHFlip,
+    RoiLabel,
+    RoiNormalize,
+    Saturation,
+    generate_batch_samples,
+    jaccard_overlap,
+    project_bbox,
+    standard_samplers,
+)
+
+
+@pytest.fixture
+def jpeg_bytes():
+    rng = np.random.RandomState(7)
+    img = (rng.rand(60, 80, 3) * 255).astype(np.uint8)
+    ok, buf = cv2.imencode(".jpg", img)
+    assert ok
+    return buf.tobytes()
+
+
+@pytest.fixture
+def feature(jpeg_bytes):
+    f = ImageFeature(jpeg_bytes, path="test.jpg")
+    return BytesToMat().transform(f)
+
+
+def test_bytes_to_mat(feature):
+    assert feature.is_valid
+    assert feature.mat.shape == (60, 80, 3)
+    assert feature.original_width() == 80
+    assert feature.original_height() == 60
+
+
+def test_corrupt_bytes_survive():
+    f = ImageFeature(b"not an image", path="bad.jpg")
+    chain = BytesToMat() >> Resize(30, 30) >> MatToFloats(valid_height=30,
+                                                          valid_width=30)
+    out = list(chain([f]))
+    assert len(out) == 1
+    assert not out[0].is_valid
+    # zero tensor of valid shape keeps the batch rectangular
+    assert out[0]["floats"].shape == (30, 30, 3)
+    assert (out[0]["floats"] == 0).all()
+
+
+def test_empty_bytes_survive():
+    f = ImageFeature(b"", path="empty.jpg")
+    out = BytesToMat().transform(f)
+    assert not out.is_valid
+
+
+@pytest.mark.parametrize("op", [
+    Brightness(-10, 10), Contrast(0.8, 1.2), Saturation(0.8, 1.2),
+    Hue(-10, 10), ChannelOrder(), ColorJitter(),
+    ChannelNormalize((104, 117, 123), (1, 1, 1)),
+])
+def test_color_ops_preserve_shape(feature, op):
+    shape = feature.mat.shape
+    out = op.transform(feature)
+    assert out.is_valid
+    assert out.mat.shape == shape
+
+
+def test_brightness_shifts_values(feature):
+    before = feature.mat.mean()
+    out = Brightness(50, 50).transform(feature)
+    assert out.mat.mean() == pytest.approx(before + 50, abs=1e-3)
+
+
+def test_channel_normalize_golden(feature):
+    m = feature.mat.copy()
+    out = ChannelNormalize((10, 20, 30), (2, 2, 2)).transform(feature)
+    np.testing.assert_allclose(out.mat, (m - [10, 20, 30]) / 2.0, atol=1e-5)
+
+
+def test_resize(feature):
+    out = Resize(300, 150).transform(feature)
+    assert out.mat.shape == (150, 300, 3)
+
+
+def test_resize_random_interp(feature):
+    out = Resize(40, 40, interp=-1).transform(feature)
+    assert out.mat.shape == (40, 40, 3)
+
+
+def test_aspect_scale(feature):
+    out = AspectScale(min_size=120, max_size=1000).transform(feature)
+    # short side 60 -> 120, long side 80 -> 160
+    assert out.mat.shape == (120, 160, 3)
+    assert out["scale"] == pytest.approx(2.0)
+
+
+def test_aspect_scale_max_cap(feature):
+    out = AspectScale(min_size=600, max_size=200).transform(feature)
+    assert max(out.mat.shape[:2]) == 200
+
+
+def test_hflip(feature):
+    left = feature.mat[:, 0].copy()
+    out = HFlip().transform(feature)
+    np.testing.assert_allclose(out.mat[:, -1], left)
+
+
+def test_expand_records_bbox(feature):
+    random.seed(3)
+    out = Expand(min_expand_ratio=2.0, max_expand_ratio=2.0).transform(feature)
+    assert out.mat.shape == (120, 160, 3)
+    eb = out["expand_bbox"]
+    # expand box spans ratio× the original, offset inside
+    assert eb[2] - eb[0] == pytest.approx(2.0, abs=1e-2)
+    assert eb[3] - eb[1] == pytest.approx(2.0, abs=1e-2)
+
+
+def test_crop_normalized(feature):
+    out = Crop(bbox=[0.25, 0.25, 0.75, 0.75]).transform(feature)
+    assert out.mat.shape == (30, 40, 3)
+    np.testing.assert_allclose(out["crop_bbox"], [0.25, 0.25, 0.75, 0.75])
+
+
+def test_center_and_random_crop(feature):
+    out = CenterCrop(40, 30).transform(feature)
+    assert out.mat.shape == (30, 40, 3)
+    f2 = BytesToMat().transform(ImageFeature(feature["bytes"]))
+    out2 = RandomCrop(40, 30).transform(f2)
+    assert out2.mat.shape == (30, 40, 3)
+
+
+def test_mat_to_floats_mean_subtract(feature):
+    m = feature.mat.copy()
+    out = MatToFloats(mean=(104, 117, 123)).transform(feature)
+    np.testing.assert_allclose(out["floats"], m - [104, 117, 123], atol=1e-4)
+
+
+def test_out_key_snapshot(feature):
+    op = Resize(20, 20).set_out_key("resized")
+    out = op.transform(feature)
+    assert out["resized"].shape == (20, 20, 3)
+
+
+# ---------------------------------------------------------------------------
+# ROI co-transforms
+# ---------------------------------------------------------------------------
+
+
+def _feature_with_label(jpeg_bytes):
+    f = BytesToMat().transform(ImageFeature(jpeg_bytes))
+    # two boxes in pixel coords on the 80x60 image
+    f["label"] = RoiLabel(labels=[1, 2],
+                          bboxes=[[8, 6, 40, 30], [40, 30, 72, 54]],
+                          difficult=[0, 1])
+    return f
+
+
+def test_roi_normalize(jpeg_bytes):
+    f = _feature_with_label(jpeg_bytes)
+    RoiNormalize().transform(f)
+    np.testing.assert_allclose(f.label.bboxes[0], [0.1, 0.1, 0.5, 0.5])
+    np.testing.assert_allclose(f.label.bboxes[1], [0.5, 0.5, 0.9, 0.9])
+
+
+def test_roi_hflip(jpeg_bytes):
+    f = _feature_with_label(jpeg_bytes)
+    RoiNormalize().transform(f)
+    RoiHFlip().transform(f)
+    np.testing.assert_allclose(f.label.bboxes[0], [0.5, 0.1, 0.9, 0.5])
+
+
+def test_roi_crop_projection_and_emit_center(jpeg_bytes):
+    f = _feature_with_label(jpeg_bytes)
+    RoiNormalize().transform(f)
+    # crop the left half: box 1 center (0.3,0.3) inside; box 2 center (0.7,0.7) out
+    Crop(bbox=[0.0, 0.0, 0.5, 1.0]).transform(f)
+    RoiCrop().transform(f)
+    assert f.label.size() == 1
+    np.testing.assert_allclose(f.label.labels, [1])
+    np.testing.assert_allclose(f.label.bboxes[0], [0.2, 0.1, 1.0, 0.5],
+                               atol=1e-6)
+
+
+def test_roi_expand_projection(jpeg_bytes):
+    f = _feature_with_label(jpeg_bytes)
+    RoiNormalize().transform(f)
+    random.seed(0)
+    Expand(min_expand_ratio=2.0, max_expand_ratio=2.0).transform(f)
+    RoiExpand().transform(f)
+    assert f.label.size() == 2
+    # boxes shrink by 2x in the expanded frame
+    b = f.label.bboxes[0]
+    assert (b[2] - b[0]) == pytest.approx(0.2, abs=1e-6)
+
+
+def test_project_bbox_helper():
+    boxes = np.array([[0.2, 0.2, 0.4, 0.4]], np.float32)
+    src = np.array([0.0, 0.0, 0.5, 0.5], np.float32)
+    out, valid = project_bbox(src, boxes)
+    np.testing.assert_allclose(out[0], [0.4, 0.4, 0.8, 0.8])
+    assert valid[0]
+
+
+def test_jaccard_overlap_host():
+    box = np.array([0.0, 0.0, 0.5, 0.5], np.float32)
+    boxes = np.array([[0.0, 0.0, 0.5, 0.5], [0.25, 0.25, 0.75, 0.75]],
+                     np.float32)
+    ious = jaccard_overlap(box, boxes)
+    assert ious[0] == pytest.approx(1.0)
+    assert ious[1] == pytest.approx(0.0625 / (0.25 + 0.25 - 0.0625))
+
+
+# ---------------------------------------------------------------------------
+# Batch samplers
+# ---------------------------------------------------------------------------
+
+
+def test_batch_sampler_constraint():
+    label = RoiLabel(labels=[1], bboxes=[[0.3, 0.3, 0.7, 0.7]])
+    s = BatchSampler(min_overlap=0.5, max_trials=200, max_sample=5)
+    random.seed(0)
+    boxes = s.sample(label)
+    for b in boxes:
+        assert jaccard_overlap(b, label.bboxes).max() >= 0.5
+
+
+def test_standard_samplers_shape():
+    samplers = standard_samplers()
+    assert len(samplers) == 7
+    label = RoiLabel(labels=[1], bboxes=[[0.4, 0.4, 0.6, 0.6]])
+    random.seed(1)
+    boxes = generate_batch_samples(label, samplers)
+    assert len(boxes) >= 1
+    for b in boxes:
+        assert 0.0 <= b[0] < b[2] <= 1.0 + 1e-6
+
+
+def test_random_sampler_keeps_feature_valid(jpeg_bytes):
+    random.seed(2)
+    f = _feature_with_label(jpeg_bytes)
+    RoiNormalize().transform(f)
+    out = RandomSampler().transform(f)
+    assert out.is_valid
+    assert out.mat is not None and out.mat.size > 0
+
+
+# ---------------------------------------------------------------------------
+# Full SSD train chain (reference IOUtils.loadTrainSet, ssd/Utils.scala:56)
+# ---------------------------------------------------------------------------
+
+
+def test_full_ssd_augmentation_chain(jpeg_bytes):
+    random.seed(11)
+    chain = (
+        BytesToMat()
+        >> RoiNormalize()
+        >> ColorJitter()
+        >> RandomTransformer(
+            # paired image+label op composed as one unit
+            Expand(min_expand_ratio=1.5, max_expand_ratio=3.0) >> RoiExpand(),
+            0.5)
+        >> RandomSampler()
+        >> Resize(300, 300, interp=-1)
+        >> RandomTransformer(HFlip() >> RoiHFlip(), 0.5)
+        >> MatToFloats(mean=(104, 117, 123))
+    )
+    feats = []
+    for i in range(8):
+        f = ImageFeature(jpeg_bytes, path=f"{i}.jpg")
+        f["label"] = RoiLabel(labels=[1, 2],
+                              bboxes=[[8, 6, 40, 30], [40, 30, 72, 54]])
+        feats.append(f)
+    out = list(chain(feats))
+    assert len(out) == 8
+    for f in out:
+        assert f.is_valid
+        assert f["floats"].shape == (300, 300, 3)
+        assert isinstance(f.label, RoiLabel)
+        if f.label.size():
+            assert f.label.bboxes.min() >= -1e-6
+            assert f.label.bboxes.max() <= 1.0 + 1e-6
