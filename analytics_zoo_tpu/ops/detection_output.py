@@ -88,8 +88,8 @@ def detection_output(loc: jax.Array, conf: jax.Array, priors: jax.Array,
 def scale_detections(dets: jax.Array, heights, widths) -> jax.Array:
     """Project normalized detections to original pixel sizes (reference
     ``BboxUtil.scaleBatchOutput:384`` using imInfo): dets (B,K,6)."""
-    h = jnp.asarray(heights).reshape(-1, 1)
-    w = jnp.asarray(widths).reshape(-1, 1)
+    h = jnp.asarray(heights).reshape(-1, 1, 1)
+    w = jnp.asarray(widths).reshape(-1, 1, 1)
     return jnp.concatenate([
         dets[..., :2],
         dets[..., 2:3] * w, dets[..., 3:4] * h,

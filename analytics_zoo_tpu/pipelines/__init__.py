@@ -1,0 +1,57 @@
+"""Task pipelines (L6): SSD detection, DeepSpeech2 ASR, fraud detection,
+plus the column-pipeline abstraction and evaluation machinery."""
+
+from analytics_zoo_tpu.pipelines.frame import (
+    Bagging,
+    Frame,
+    FramePipeline,
+    FuncTransformer,
+    Stage,
+    StandardScaler,
+    StratifiedSampler,
+    VectorAssembler,
+    time_ordered_split,
+)
+from analytics_zoo_tpu.pipelines.evaluation import (
+    DetectionResult,
+    MeanAveragePrecision,
+    PascalVocEvaluator,
+    mark_tp_fp,
+    voc_ap,
+)
+from analytics_zoo_tpu.pipelines.voc import (
+    VOC_CLASSES,
+    Coco,
+    PascalVoc,
+    get_imdb,
+    parse_voc_annotation,
+    to_ssd_records,
+)
+from analytics_zoo_tpu.pipelines.ssd import (
+    PreProcessParam,
+    RecordToFeature,
+    RoiImageToBatch,
+    SSDMeanAveragePrecision,
+    SSDPredictor,
+    TrainParams,
+    Validator,
+    load_train_set,
+    load_val_set,
+    train_ssd,
+    train_transformer,
+    val_transformer,
+)
+from analytics_zoo_tpu.pipelines.fraud import (
+    FraudResult,
+    MLPClassifier,
+    auprc,
+    precision_recall,
+    run_fraud_pipeline,
+)
+from analytics_zoo_tpu.pipelines.deepspeech2 import (
+    DS2Param,
+    DeepSpeech2Pipeline,
+    make_ds2_model,
+)
+
+__all__ = [k for k in dir() if not k.startswith("_")]

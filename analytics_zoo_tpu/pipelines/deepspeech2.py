@@ -1,0 +1,136 @@
+"""DeepSpeech2 inference pipeline: audio → transcript, batched on TPU.
+
+Port of the reference's L6 ASR pipeline (``deepspeech2/example/
+InferenceExample.scala:11``, ``InferenceEvaluate.scala:14``): read audio →
+TimeSegmenter chunks tagged (audio_id, seq) → featurize → model forward →
+greedy CTC decode → re-join per utterance ordered by seq → WER/CER.
+
+The reference forwards one 1×1×13×T chunk per DataFrame row (batch size 1,
+SURVEY.md §3.4 hot-loop note); here all segments across utterances are
+padded to ``utt_length`` and forwarded as ONE batch per ``batch_size``
+group — the MXU sees big matmuls, not row-at-a-time traffic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.core.module import Model
+from analytics_zoo_tpu.models import DeepSpeech2
+from analytics_zoo_tpu.parallel import make_eval_step
+from analytics_zoo_tpu.transform.audio import (
+    ALPHABET,
+    ASREvaluator,
+    SAMPLE_RATE,
+    TimeSegmenter,
+    VocabDecoder,
+    best_path_decode,
+    featurize,
+    read_audio,
+)
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+
+@dataclasses.dataclass
+class DS2Param:
+    """Reference ``util/Param.scala:17-34``: segment seconds, partitions…"""
+
+    segment_seconds: int = 30
+    batch_size: int = 8
+    n_mels: int = 13
+    vocab: Optional[Sequence[str]] = None
+
+    @property
+    def utt_length(self) -> int:
+        # uttLength = segment·100 frames (reference InferenceExample.scala:58)
+        return self.segment_seconds * 100
+
+
+class DeepSpeech2Pipeline:
+    """fit-less inference pipeline (the reference's Spark ML Pipeline of 6
+    stages collapses into segment → featurize → forward → decode)."""
+
+    def __init__(self, model: Model, param: DS2Param = DS2Param()):
+        self.model = model
+        self.param = param
+        self.segmenter = TimeSegmenter(
+            segment_size=SAMPLE_RATE * param.segment_seconds)
+        self._eval_step = make_eval_step(model.module)
+        self.vocab_decoder = (VocabDecoder(param.vocab)
+                              if param.vocab else None)
+
+    def transcribe_samples(self, utterances: Dict[str, np.ndarray]
+                           ) -> Dict[str, str]:
+        """{audio_id: samples} → {audio_id: transcript}."""
+        segments: List[dict] = []
+        for audio_id, samples in utterances.items():
+            segments.extend(self.segmenter.segment(samples, audio_id))
+        feats = np.stack([
+            featurize(s["samples"], utt_length=self.param.utt_length,
+                      n_mels=self.param.n_mels)
+            for s in segments
+        ]) if segments else np.zeros((0, self.param.utt_length,
+                                      self.param.n_mels), np.float32)
+
+        texts: List[str] = []
+        for i in range(0, len(segments), self.param.batch_size):
+            chunk = feats[i:i + self.param.batch_size]
+            log_probs = self._eval_step(self.model.variables,
+                                        jnp.asarray(chunk))
+            texts.extend(best_path_decode(np.asarray(log_probs[j]))
+                         for j in range(chunk.shape[0]))
+
+        # re-join by (audio_id, audio_seq) (reference InferenceEvaluate
+        # groupBy(audio_id).sort(audio_seq) concat)
+        joined: Dict[str, List[Tuple[int, str]]] = {}
+        for seg, text in zip(segments, texts):
+            joined.setdefault(seg["audio_id"], []).append(
+                (seg["audio_seq"], text))
+        out = {}
+        for audio_id, parts in joined.items():
+            text = " ".join(t for _, t in sorted(parts)).strip()
+            if self.vocab_decoder is not None:
+                text = self.vocab_decoder(text)
+            out[audio_id] = text
+        return out
+
+    def transcribe_files(self, paths: Sequence[str]) -> Dict[str, str]:
+        utts = {}
+        for p in paths:
+            samples, rate = read_audio(p)
+            if rate != SAMPLE_RATE:
+                raise ValueError(f"{p}: expected {SAMPLE_RATE} Hz, got {rate}")
+            utts[p] = samples
+        return self.transcribe_samples(utts)
+
+    def evaluate(self, utterances: Dict[str, np.ndarray],
+                 transcripts: Dict[str, str]) -> ASREvaluator:
+        """WER/CER over labeled utterances (reference InferenceEvaluate
+        per-utterance WER/CER print + total time log)."""
+        t0 = time.time()
+        hyps = self.transcribe_samples(utterances)
+        ev = ASREvaluator()
+        for audio_id, ref in transcripts.items():
+            hyp = hyps.get(audio_id, "")
+            ev.add(ref.upper(), hyp)
+        dt = time.time() - t0
+        logger.info("DS2 eval: %d utterances in %.2fs (%.2f utt/sec), "
+                    "WER=%.4f CER=%.4f", len(transcripts), dt,
+                    len(transcripts) / max(dt, 1e-9), ev.wer, ev.cer)
+        return ev
+
+
+def make_ds2_model(hidden: int = 1024, n_rnn_layers: int = 3,
+                   n_mels: int = 13, utt_length: int = 300,
+                   seed: int = 0) -> Model:
+    model = Model(DeepSpeech2(hidden=hidden, n_rnn_layers=n_rnn_layers,
+                              n_mels=n_mels))
+    model.build(seed, jnp.zeros((1, utt_length, n_mels)))
+    return model

@@ -1,0 +1,196 @@
+"""Detection evaluation: Pascal VOC mAP machinery.
+
+Port of the reference's ``common/EvalUtil.scala`` (per-batch TP/FP marking
+with difficult handling ``evaluateBatch:100``, ``computeAP:195``, VOC07
+11-point vs area-under-PR ``vocAp:37``), ``common/DetectionResult.scala``
+(the ``+``-mergeable ValidationMethod plugged into the optimizer's
+validation loop) and ``common/PascalVocEvaluator.scala`` (per-class AP
+printout, 07 vs 10+ metric by year).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def voc_ap(recall: np.ndarray, precision: np.ndarray,
+           use_07_metric: bool = False) -> float:
+    """AP from a PR curve (reference ``EvalUtil.vocAp:37``): 11-point
+    interpolation (VOC07) or area under the monotonized curve (VOC10+)."""
+    if use_07_metric:
+        ap = 0.0
+        for t in np.arange(0.0, 1.1, 0.1):
+            mask = recall >= t
+            p = float(precision[mask].max()) if mask.any() else 0.0
+            ap += p / 11.0
+        return ap
+    mrec = np.concatenate([[0.0], recall, [1.0]])
+    mpre = np.concatenate([[0.0], precision, [0.0]])
+    for i in range(len(mpre) - 2, -1, -1):
+        mpre[i] = max(mpre[i], mpre[i + 1])
+    idx = np.where(mrec[1:] != mrec[:-1])[0]
+    return float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+
+
+def mark_tp_fp(det_boxes: np.ndarray, det_scores: np.ndarray,
+               gt_boxes: np.ndarray, gt_difficult: np.ndarray,
+               iou_threshold: float = 0.5,
+               normalized: bool = False) -> np.ndarray:
+    """Greedy-match one image's detections (sorted by score desc) against
+    gt (reference ``EvalUtil.evaluateBatch:100`` inner loop).
+
+    Returns (N, 3) rows (score, tp, fp); detections matching a *difficult*
+    gt count as neither.
+    """
+    order = np.argsort(-det_scores)
+    taken = np.zeros(len(gt_boxes), bool)
+    out = np.zeros((len(det_boxes), 3), np.float32)
+    off = 0.0 if normalized else 1.0
+    for row, i in enumerate(order):
+        out[row, 0] = det_scores[i]
+        best_iou, best_j = 0.0, -1
+        for j in range(len(gt_boxes)):
+            gx1, gy1, gx2, gy2 = gt_boxes[j]
+            x1 = max(det_boxes[i, 0], gx1)
+            y1 = max(det_boxes[i, 1], gy1)
+            x2 = min(det_boxes[i, 2], gx2)
+            y2 = min(det_boxes[i, 3], gy2)
+            iw, ih = max(x2 - x1 + off, 0), max(y2 - y1 + off, 0)
+            inter = iw * ih
+            if inter <= 0:
+                continue
+            a = ((det_boxes[i, 2] - det_boxes[i, 0] + off)
+                 * (det_boxes[i, 3] - det_boxes[i, 1] + off))
+            b = (gx2 - gx1 + off) * (gy2 - gy1 + off)
+            iou = inter / (a + b - inter)
+            if iou > best_iou:
+                best_iou, best_j = iou, j
+        if best_iou >= iou_threshold and best_j >= 0:
+            if gt_difficult[best_j] > 0:
+                continue                       # difficult: ignore entirely
+            if not taken[best_j]:
+                out[row, 1] = 1.0              # tp
+                taken[best_j] = True
+            else:
+                out[row, 2] = 1.0              # duplicate -> fp
+        else:
+            out[row, 2] = 1.0                  # no match -> fp
+    return out
+
+
+class DetectionResult:
+    """Mergeable per-class accumulation of (score, tp, fp) + positive count
+    (reference ``DetectionResult.scala:25,57`` monoid)."""
+
+    name = "MeanAveragePrecision"
+
+    def __init__(self, n_classes: int, use_07_metric: bool = True,
+                 class_names: Optional[Sequence[str]] = None):
+        self.n_classes = n_classes
+        self.use_07_metric = use_07_metric
+        self.class_names = class_names
+        self.marks: Dict[int, List[np.ndarray]] = {c: [] for c in range(n_classes)}
+        self.npos = np.zeros(n_classes, np.int64)
+
+    def __add__(self, other: "DetectionResult") -> "DetectionResult":
+        out = DetectionResult(self.n_classes, self.use_07_metric,
+                              self.class_names)
+        for c in range(self.n_classes):
+            out.marks[c] = self.marks[c] + other.marks[c]
+        out.npos = self.npos + other.npos
+        return out
+
+    def ap_per_class(self) -> np.ndarray:
+        aps = np.zeros(self.n_classes, np.float32)
+        for c in range(self.n_classes):
+            if self.npos[c] == 0:
+                aps[c] = np.nan
+                continue
+            if not self.marks[c]:
+                aps[c] = 0.0
+                continue
+            rows = np.concatenate(self.marks[c], axis=0)
+            order = np.argsort(-rows[:, 0])
+            tp = np.cumsum(rows[order, 1])
+            fp = np.cumsum(rows[order, 2])
+            recall = tp / self.npos[c]
+            precision = tp / np.maximum(tp + fp, 1e-12)
+            aps[c] = voc_ap(recall, precision, self.use_07_metric)
+        return aps
+
+    def result(self) -> float:
+        aps = self.ap_per_class()
+        valid = ~np.isnan(aps)
+        return float(aps[valid].mean()) if valid.any() else 0.0
+
+    def __repr__(self):
+        return f"{self.name}: {self.result():.4f}"
+
+
+class MeanAveragePrecision:
+    """ValidationMethod over ``(detections, target)`` batches — plugs into
+    ``parallel.validate`` the way the reference plugs its
+    MeanAveragePrecision into the Optimizer's validation loop.
+
+    ``output``: (B, K, 6) DetectionOutput rows (cls, score, x1,y1,x2,y2).
+    ``batch["target"]``: padded gt dict (bboxes (B,G,4), labels (B,G),
+    difficult (B,G) optional, mask (B,G)).
+    """
+
+    def __init__(self, n_classes: int = 21, use_07_metric: bool = True,
+                 iou_threshold: float = 0.5, normalized: bool = True,
+                 class_names: Optional[Sequence[str]] = None):
+        self.n_classes = n_classes
+        self.use_07_metric = use_07_metric
+        self.iou = iou_threshold
+        self.normalized = normalized
+        self.class_names = class_names
+        self.name = "MeanAveragePrecision"
+
+    def __call__(self, output, batch) -> DetectionResult:
+        dets = np.asarray(output)
+        target = batch["target"]
+        gt_boxes = np.asarray(target["bboxes"])
+        gt_labels = np.asarray(target["labels"])
+        gt_mask = np.asarray(target["mask"])
+        gt_diff = np.asarray(target.get("difficult", np.zeros_like(gt_mask)))
+        res = DetectionResult(self.n_classes, self.use_07_metric,
+                              self.class_names)
+        B = dets.shape[0]
+        for b in range(B):
+            valid_gt = gt_mask[b] > 0
+            for c in range(1, self.n_classes):
+                cls_gt = valid_gt & (gt_labels[b] == c)
+                res.npos[c] += int((cls_gt & (gt_diff[b] == 0)).sum())
+                sel = (dets[b, :, 0] == c) & (dets[b, :, 1] > 0)
+                if not sel.any():
+                    continue
+                marks = mark_tp_fp(
+                    dets[b, sel, 2:6], dets[b, sel, 1],
+                    gt_boxes[b][cls_gt], gt_diff[b][cls_gt],
+                    self.iou, self.normalized)
+                res.marks[c].append(marks)
+        return res
+
+
+class PascalVocEvaluator:
+    """Standalone evaluator with per-class AP printout (reference
+    ``PascalVocEvaluator.scala:33``; metric picked by year: 2007 → 11-point)."""
+
+    def __init__(self, image_set: str = "voc_2007_test",
+                 class_names: Optional[Sequence[str]] = None):
+        self.use_07_metric = "2007" in image_set
+        self.class_names = class_names
+        self.method = None
+
+    def evaluate(self, result: DetectionResult) -> float:
+        aps = result.ap_per_class()
+        names = self.class_names or [str(i) for i in range(len(aps))]
+        for name, ap in zip(names[1:], aps[1:]):
+            if not np.isnan(ap):
+                print(f"AP for {name} = {ap:.4f}")
+        m = result.result()
+        print(f"Mean AP = {m:.4f}")
+        return m

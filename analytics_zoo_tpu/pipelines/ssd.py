@@ -1,0 +1,368 @@
+"""SSD pipeline: train / test / predict over the TPU runtime.
+
+Port of the reference's L6 pipeline (``pipeline/ssd``): the canonical data
+chains (``IOUtils.loadTrainSet/loadValSet``, ``ssd/Utils.scala:56,72``),
+``SSDPredictor`` (``ssd/SSDPredictor.scala:30``), ``Validator``
+(``ssd/Validator.scala:34`` with its throughput log) and the ``Train``
+entry point's optimizer assembly (``ssd/example/Train.scala:140-252``:
+optional Adam warm-up to a target mAP, then SGD + MultiStep/Plateau,
+per-epoch validation/checkpoint/summaries).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.core.module import Model
+from analytics_zoo_tpu.data import (
+    DataSet,
+    RandomTransformer,
+    SSDByteRecord,
+    Transformer,
+    pad_ragged,
+)
+from analytics_zoo_tpu.models import SSDVgg, build_priors, ssd300_config, ssd512_config
+from analytics_zoo_tpu.ops import (
+    DetectionOutputParam,
+    MultiBoxLoss,
+    MultiBoxLossParam,
+    detection_output,
+    scale_detections,
+)
+from analytics_zoo_tpu.parallel import (
+    SGD,
+    Adam,
+    Optimizer,
+    Plateau,
+    TrainSummary,
+    Trigger,
+    ValidationSummary,
+    create_mesh,
+    make_eval_step,
+    multistep,
+)
+from analytics_zoo_tpu.pipelines.evaluation import DetectionResult, MeanAveragePrecision
+from analytics_zoo_tpu.transform.vision import (
+    BytesToMat,
+    ColorJitter,
+    Expand,
+    HFlip,
+    ImageFeature,
+    MatToFloats,
+    RandomSampler,
+    Resize,
+    RoiExpand,
+    RoiHFlip,
+    RoiLabel,
+    RoiNormalize,
+)
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+# Caffe-VGG channel means, BGR (reference PreProcessParam meansRGB defaults)
+BGR_MEANS = (104.0, 117.0, 123.0)
+
+
+@dataclasses.dataclass
+class PreProcessParam:
+    """Reference ``PreProcessParam`` (``ssd/model/SSDGraph.scala:30``)."""
+
+    batch_size: int = 32
+    resolution: int = 300
+    pixel_means: Sequence[float] = BGR_MEANS
+    n_partition: int = 1
+    max_gt: int = 100
+
+
+class RecordToFeature(Transformer):
+    """SSDByteRecord → ImageFeature (reference ``RecordToFeature.scala:28``)."""
+
+    def transform(self, record: SSDByteRecord) -> ImageFeature:
+        f = ImageFeature(record.data, path=record.path)
+        gt = record.gt if record.gt is not None else np.zeros((0, 6), np.float32)
+        f["label"] = RoiLabel.from_gt_matrix(gt)
+        return f
+
+
+class RoiImageToBatch(Transformer):
+    """Batch ImageFeatures into padded device-ready dicts — the
+    ``SSDMiniBatch`` equivalent (reference ``RoiImageToBatch.scala:41``,
+    ``Types.scala:41``): CHW float pack becomes NHWC stack; the ragged
+    7-col label matrix becomes (B, max_gt, ·) + mask (SURVEY.md §7.3)."""
+
+    def __init__(self, batch_size: int, max_gt: int = 100,
+                 keep_label: bool = True, drop_remainder: bool = True):
+        self.batch_size = batch_size
+        self.max_gt = max_gt
+        self.keep_label = keep_label
+        self.drop_remainder = drop_remainder
+
+    def apply_iter(self, it):
+        buf: List[ImageFeature] = []
+        for f in it:
+            if not f.is_valid and f.get("floats") is None:
+                continue
+            buf.append(f)
+            if len(buf) == self.batch_size:
+                yield self.collate(buf)
+                buf = []
+        if buf and not self.drop_remainder:
+            yield self.collate(buf)
+
+    def collate(self, feats: Sequence[ImageFeature]) -> Dict:
+        imgs = np.stack([f["floats"] for f in feats]).astype(np.float32)
+        im_info = np.stack([f.get_im_info() for f in feats])
+        batch = {"input": imgs, "im_info": im_info}
+        if self.keep_label:
+            boxes, labels, difficult = [], [], []
+            for f in feats:
+                lab = f.label if isinstance(f.label, RoiLabel) else RoiLabel(
+                    np.zeros(0), np.zeros((0, 4)))
+                boxes.append(lab.bboxes)
+                labels.append(lab.labels.reshape(-1, 1))
+                difficult.append(lab.difficult.reshape(-1, 1))
+            b, mask = pad_ragged(boxes, self.max_gt)
+            l, _ = pad_ragged(labels, self.max_gt)
+            d, _ = pad_ragged(difficult, self.max_gt)
+            batch["target"] = {
+                "bboxes": b, "labels": l[..., 0].astype(np.int32),
+                "difficult": d[..., 0], "mask": mask,
+            }
+        return batch
+
+
+def train_transformer(param: PreProcessParam) -> Transformer:
+    """The canonical SSD augmentation chain (reference
+    ``IOUtils.loadTrainSet:56``): RecordToFeature -> BytesToMat ->
+    RoiNormalize -> ColorJitter -> Random(Expand->RoiExpand) ->
+    RandomSampler -> Resize(random interp) -> Random(HFlip->RoiHFlip) ->
+    MatToFloats(mean subtract)."""
+    return (
+        RecordToFeature()
+        >> BytesToMat()
+        >> RoiNormalize()
+        >> ColorJitter()
+        >> RandomTransformer(Expand(means=param.pixel_means) >> RoiExpand(), 0.5)
+        >> RandomSampler()
+        >> Resize(param.resolution, param.resolution, interp=-1)
+        >> RandomTransformer(HFlip() >> RoiHFlip(), 0.5)
+        >> MatToFloats(mean=param.pixel_means,
+                       valid_height=param.resolution,
+                       valid_width=param.resolution)
+    )
+
+
+def val_transformer(param: PreProcessParam) -> Transformer:
+    """Validation chain without augmentation (reference ``loadValSet:72``)."""
+    return (
+        RecordToFeature()
+        >> BytesToMat()
+        >> RoiNormalize()
+        >> Resize(param.resolution, param.resolution)
+        >> MatToFloats(mean=param.pixel_means,
+                       valid_height=param.resolution,
+                       valid_width=param.resolution)
+    )
+
+
+def load_train_set(pattern: str, param: PreProcessParam) -> DataSet:
+    return (DataSet.from_record_files(pattern, SSDByteRecord.decode,
+                                      shuffle_files=True)
+            .transform(train_transformer(param))
+            .transform(RoiImageToBatch(param.batch_size, param.max_gt)))
+
+
+def load_val_set(pattern: str, param: PreProcessParam) -> DataSet:
+    return (DataSet.from_record_files(pattern, SSDByteRecord.decode)
+            .transform(val_transformer(param))
+            .transform(RoiImageToBatch(param.batch_size, param.max_gt,
+                                       drop_remainder=False)))
+
+
+class SSDPredictor:
+    """Distributed inference (reference ``SSDPredictor.scala:30``): jitted
+    forward + in-graph DetectionOutput, detections rescaled to original
+    image size via im_info (``BboxUtil.scaleBatchOutput``)."""
+
+    def __init__(self, model: Model, param: PreProcessParam,
+                 post: Optional[DetectionOutputParam] = None,
+                 n_classes: int = 21):
+        self.model = model
+        self.param = param
+        self.post = post or DetectionOutputParam(n_classes=n_classes)
+        priors, variances = build_priors(
+            ssd300_config() if param.resolution == 300 else ssd512_config())
+        self._priors = jnp.asarray(priors)
+        self._variances = jnp.asarray(variances)
+        self._eval_step = make_eval_step(model.module)
+
+    def set_top_k(self, k: int) -> "SSDPredictor":
+        """Mutate keep_topk (reference ``setTopK`` mutating DetectionOutput)."""
+        self.post = dataclasses.replace(self.post, keep_topk=k)
+        return self
+
+    def detect_normalized(self, inputs) -> jnp.ndarray:
+        """Forward + softmax + DetectionOutput → (B, K, 6) normalized-box
+        detections (shared by predict and Validator so serving and eval
+        can't diverge)."""
+        loc, conf = self._eval_step(self.model.variables, jnp.asarray(inputs))
+        probs = jax.nn.softmax(conf, axis=-1)
+        return detection_output(loc, probs, self._priors, self._variances,
+                                self.post)
+
+    def detect_batch(self, batch: Dict) -> np.ndarray:
+        dets = self.detect_normalized(batch["input"])
+        # rescale normalized boxes to ORIGINAL pixel sizes: im_info rows are
+        # (h, w, scale_h, scale_w); original = current / scale
+        h = batch["im_info"][:, 0] / np.maximum(batch["im_info"][:, 2], 1e-8)
+        w = batch["im_info"][:, 1] / np.maximum(batch["im_info"][:, 3], 1e-8)
+        return np.asarray(scale_detections(dets, h, w))
+
+    def predict(self, records) -> List[np.ndarray]:
+        """records: iterable of SSDByteRecord → per-image (K, 6) arrays."""
+        chain = (val_transformer(self.param)
+                 >> RoiImageToBatch(self.param.batch_size, keep_label=False,
+                                    drop_remainder=False))
+        out: List[np.ndarray] = []
+        for batch in chain(records):
+            dets = self.detect_batch(batch)
+            out.extend(dets[i] for i in range(dets.shape[0]))
+        return out
+
+
+class Validator:
+    """Distributed eval with throughput logging (reference
+    ``Validator.scala:34,56-86``: forward + evaluator per batch, monoid
+    reduce, records/sec accumulator log)."""
+
+    def __init__(self, model: Model, param: PreProcessParam,
+                 evaluator: Optional[MeanAveragePrecision] = None,
+                 post: Optional[DetectionOutputParam] = None):
+        self.predictor = SSDPredictor(model, param, post=post)
+        self.evaluator = evaluator or MeanAveragePrecision()
+
+    def test(self, dataset) -> DetectionResult:
+        total: Optional[DetectionResult] = None
+        n_records = 0
+        t0 = time.time()
+        for batch in dataset:
+            dets = self.predictor.detect_normalized(batch["input"])
+            r = self.evaluator(np.asarray(dets), batch)
+            total = r if total is None else total + r
+            n_records += batch["input"].shape[0]
+        dt = time.time() - t0
+        logger.info("[Prediction] %d in %.2f seconds. Throughput is %.2f "
+                    "records/sec", n_records, dt, n_records / max(dt, 1e-9))
+        return total
+
+
+class SSDMeanAveragePrecision:
+    """ValidationMethod adapter for the Optimizer's validation loop: the
+    raw SSDVgg output is (loc, conf) logits, so decode + NMS runs here
+    before delegating to MeanAveragePrecision (the reference's
+    MeanAveragePrecision similarly decodes inside the ValidationMethod,
+    ``DetectionResult.scala`` → ``BboxUtil.decodeBatchOutput``)."""
+
+    def __init__(self, n_classes: int = 21, resolution: int = 300,
+                 post: Optional[DetectionOutputParam] = None,
+                 use_07_metric: bool = True):
+        self.inner = MeanAveragePrecision(n_classes=n_classes,
+                                          use_07_metric=use_07_metric)
+        self.post = post or DetectionOutputParam(n_classes=n_classes)
+        priors, variances = build_priors(
+            ssd300_config() if resolution == 300 else ssd512_config())
+        self._priors = jnp.asarray(priors)
+        self._variances = jnp.asarray(variances)
+        self.name = self.inner.name
+
+    def __call__(self, output, batch) -> DetectionResult:
+        loc, conf = output
+        probs = jax.nn.softmax(conf, axis=-1)
+        dets = detection_output(loc, probs, self._priors, self._variances,
+                                self.post)
+        return self.inner(np.asarray(dets), batch)
+
+
+@dataclasses.dataclass
+class TrainParams:
+    """Reference ``TrainParams`` (``ssd/example/Train.scala:39``)."""
+
+    batch_size: int = 32
+    resolution: int = 300
+    n_classes: int = 21
+    learning_rate: float = 0.0035
+    momentum: float = 0.9
+    weight_decay: float = 0.0005
+    max_epoch: int = 250
+    schedule: str = "plateau"           # 'plateau' | 'multistep'
+    lr_steps: Sequence[int] = ()
+    warm_up_map: Optional[float] = None  # Adam warm-up target mAP
+    warm_up_lr: float = 1e-4
+    checkpoint_path: Optional[str] = None
+    overwrite_checkpoint: bool = True
+    log_dir: Optional[str] = None
+    job_name: str = "ssd300"
+    max_gt: int = 100
+
+
+def train_ssd(train_set, val_set, params: TrainParams,
+              model: Optional[Model] = None, mesh=None) -> Model:
+    """The Train entry point's optimize() assembly (reference
+    ``Train.scala:150-252``)."""
+    mesh = mesh or create_mesh()
+    cfg = (ssd300_config() if params.resolution == 300 else ssd512_config())
+    priors, variances = build_priors(cfg)
+    criterion = MultiBoxLoss(priors, variances,
+                             MultiBoxLossParam(n_classes=params.n_classes))
+    if model is None:
+        model = Model(SSDVgg(num_classes=params.n_classes,
+                             resolution=params.resolution))
+        model.build(0, jnp.zeros((1, params.resolution, params.resolution, 3)))
+
+    evaluator = SSDMeanAveragePrecision(n_classes=params.n_classes,
+                                        resolution=params.resolution)
+
+    def make_optimizer(optim_method, end_when):
+        opt = (Optimizer(model, train_set, criterion, mesh=mesh,
+                         skip_loss_above=50.0)
+               .set_optim_method(optim_method)
+               .set_end_when(end_when))
+        if val_set is not None:
+            opt.set_validation(Trigger.every_epoch(), val_set, [evaluator])
+        if params.checkpoint_path:
+            opt.set_checkpoint(params.checkpoint_path, Trigger.every_epoch(),
+                               overwrite=params.overwrite_checkpoint)
+        if params.log_dir:
+            opt.set_train_summary(TrainSummary(params.log_dir, params.job_name))
+            opt.set_validation_summary(
+                ValidationSummary(params.log_dir, params.job_name))
+        return opt
+
+    # optional Adam warm-up until a target mAP (reference Train.scala:178-187)
+    if params.warm_up_map is not None and val_set is not None:
+        logger.info("warm-up with Adam until mAP >= %.3f", params.warm_up_map)
+        make_optimizer(
+            Adam(params.warm_up_lr),
+            Trigger.or_(Trigger.max_score(params.warm_up_map),
+                        Trigger.max_epoch(params.max_epoch)),
+        ).optimize()
+
+    if params.schedule == "multistep" and params.lr_steps:
+        optim = SGD(params.learning_rate, momentum=params.momentum,
+                    weight_decay=params.weight_decay,
+                    schedule=multistep(params.learning_rate, params.lr_steps,
+                                       0.1))
+    else:
+        optim = SGD(params.learning_rate, momentum=params.momentum,
+                    weight_decay=params.weight_decay,
+                    plateau=Plateau(monitor="score", factor=0.5, patience=10,
+                                    mode="max", min_lr=1e-5))
+    make_optimizer(optim, Trigger.max_epoch(params.max_epoch)).optimize()
+    return model

@@ -292,9 +292,11 @@ class Expand(FeatureTransformer):
     means, recording the normalized expand bbox for label re-projection
     (reference ``Expand.scala:28``)."""
 
-    def __init__(self, means: Sequence[float] = (123.0, 117.0, 104.0),
+    def __init__(self, means: Sequence[float] = (104.0, 117.0, 123.0),
                  max_expand_ratio: float = 4.0,
                  min_expand_ratio: float = 1.0):
+        # means are BGR, matching the mat layout (reference Expand.scala
+        # fills channel 0 with meansB=104 .. channel 2 with meansR=123)
         super().__init__()
         self.means = np.asarray(means, np.float32)
         self.min_ratio = min_expand_ratio

@@ -1,0 +1,94 @@
+"""Benchmark runner — prints ONE JSON line for the driver.
+
+Measures SSD300-VGG data-parallel training throughput (images/sec/chip),
+the headline metric from BASELINE.json ("SSD300 images/sec/chip").  The
+reference publishes no absolute numbers (BASELINE.md: mechanism only), so
+``vs_baseline`` compares against the reference's *cluster-shape anchor*:
+the SSD README's 4×28-core Xeon training setup, credited at an optimistic
+~56 images/sec total (2 img/s/core) — i.e. vs_baseline = ours / 56.
+
+Usage: ``python bench.py [--batch N] [--steps N] [--warmup N] [--res 300]``
+Runs on whatever jax.devices() provides (1 real TPU chip under the driver).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+REFERENCE_ANCHOR_IMAGES_PER_SEC = 56.0  # 4 executors x 28 cores x ~0.5 img/s
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--res", type=int, default=300)
+    p.add_argument("--classes", type=int, default=21)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.models import SSDVgg, build_priors, ssd300_config
+    from analytics_zoo_tpu.ops import MultiBoxLoss
+    from analytics_zoo_tpu.parallel import (
+        SGD,
+        create_mesh,
+        create_train_state,
+        make_train_step,
+        replicate,
+        shard_batch,
+    )
+
+    n_chips = jax.device_count()
+    mesh = create_mesh()
+    model = Model(SSDVgg(num_classes=args.classes, resolution=args.res))
+    model.build(0, jnp.zeros((1, args.res, args.res, 3), jnp.float32))
+    priors, variances = build_priors(ssd300_config())
+    criterion = MultiBoxLoss(priors, variances)
+    optim = SGD(1e-3, momentum=0.9)
+    state = replicate(create_train_state(model, optim), mesh)
+    step = make_train_step(model.module, criterion, optim, mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "input": rng.rand(args.batch, args.res, args.res, 3).astype(np.float32),
+        "target": {
+            "bboxes": np.tile(np.asarray([0.1, 0.1, 0.6, 0.6], np.float32),
+                              (args.batch, 8, 1)),
+            "labels": rng.randint(1, args.classes, (args.batch, 8)).astype(np.int32),
+            "mask": np.ones((args.batch, 8), np.float32),
+        },
+    }
+    dev_batch = shard_batch(batch, mesh)
+
+    for _ in range(args.warmup):
+        state, metrics = step(state, dev_batch, 1.0)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, metrics = step(state, dev_batch, 1.0)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    images_per_sec = args.batch * args.steps / dt
+    per_chip = images_per_sec / max(n_chips, 1)
+    print(json.dumps({
+        "metric": "ssd300_train_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(images_per_sec / REFERENCE_ANCHOR_IMAGES_PER_SEC, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,322 @@
+"""Pipeline tests: mAP golden values, frame pipeline, fraud end-to-end,
+SSD data chain + predictor, DS2 transcription, VOC parsing."""
+
+import os
+import textwrap
+
+import cv2
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from analytics_zoo_tpu.core.module import Model
+from analytics_zoo_tpu.data import SSDByteRecord, write_ssd_records
+from analytics_zoo_tpu.models import SSDVgg
+from analytics_zoo_tpu.pipelines import (
+    Bagging,
+    DS2Param,
+    DeepSpeech2Pipeline,
+    FramePipeline,
+    FuncTransformer,
+    MLPClassifier,
+    MeanAveragePrecision,
+    PreProcessParam,
+    RecordToFeature,
+    RoiImageToBatch,
+    SSDPredictor,
+    StandardScaler,
+    StratifiedSampler,
+    VOC_CLASSES,
+    VectorAssembler,
+    auprc,
+    load_train_set,
+    load_val_set,
+    make_ds2_model,
+    mark_tp_fp,
+    parse_voc_annotation,
+    time_ordered_split,
+    voc_ap,
+    train_transformer,
+)
+from analytics_zoo_tpu.transform.audio import SAMPLE_RATE
+
+
+# ---------------------------------------------------------------------------
+# mAP machinery (reference EvalUtilSpec golden style)
+# ---------------------------------------------------------------------------
+
+
+def test_voc_ap_perfect():
+    recall = np.array([0.5, 1.0])
+    precision = np.array([1.0, 1.0])
+    assert voc_ap(recall, precision, use_07_metric=False) == pytest.approx(1.0)
+    assert voc_ap(recall, precision, use_07_metric=True) == pytest.approx(1.0)
+
+
+def test_voc_ap_half():
+    # one tp then one fp over 2 gt: recall .5, precision drops 1 -> .5
+    recall = np.array([0.5, 0.5])
+    precision = np.array([1.0, 0.5])
+    ap = voc_ap(recall, precision, use_07_metric=False)
+    assert ap == pytest.approx(0.5)
+    ap07 = voc_ap(recall, precision, use_07_metric=True)
+    assert ap07 == pytest.approx(6 / 11, abs=1e-6)
+
+
+def test_mark_tp_fp_duplicates_and_difficult():
+    gt = np.array([[0.0, 0.0, 10.0, 10.0], [20.0, 20.0, 30.0, 30.0]])
+    difficult = np.array([0.0, 1.0])
+    dets = np.array([
+        [0.0, 0.0, 10.0, 10.0],    # tp
+        [0.5, 0.5, 10.0, 10.0],    # duplicate of gt0 -> fp
+        [20.0, 20.0, 30.0, 30.0],  # matches difficult -> neither
+        [50.0, 50.0, 60.0, 60.0],  # no match -> fp
+    ])
+    scores = np.array([0.9, 0.8, 0.7, 0.6])
+    out = mark_tp_fp(dets, scores, gt, difficult, 0.5)
+    assert out[:, 1].tolist() == [1.0, 0.0, 0.0, 0.0]
+    assert out[:, 2].tolist() == [0.0, 1.0, 0.0, 1.0]
+
+
+def test_mean_average_precision_perfect_detection():
+    m = MeanAveragePrecision(n_classes=3)
+    dets = np.zeros((1, 5, 6), np.float32)
+    dets[0, 0] = [1, 0.9, 0.1, 0.1, 0.4, 0.4]
+    dets[0, 1] = [2, 0.8, 0.5, 0.5, 0.9, 0.9]
+    dets[0, 2:] = [-1, 0, 0, 0, 0, 0]
+    batch = {"target": {
+        "bboxes": np.array([[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]]],
+                           np.float32),
+        "labels": np.array([[1, 2]], np.float32),
+        "mask": np.ones((1, 2), np.float32),
+    }}
+    res = m(dets, batch)
+    assert res.result() == pytest.approx(1.0)
+    merged = res + m(dets, batch)
+    assert merged.result() == pytest.approx(1.0)
+    assert merged.npos[1] == 2
+
+
+def test_mean_average_precision_miss():
+    m = MeanAveragePrecision(n_classes=2)
+    dets = np.full((1, 3, 6), -1, np.float32)
+    dets[:, :, 1] = 0
+    batch = {"target": {
+        "bboxes": np.array([[[0.1, 0.1, 0.4, 0.4]]], np.float32),
+        "labels": np.array([[1]], np.float32),
+        "mask": np.ones((1, 1), np.float32),
+    }}
+    assert m(dets, batch).result() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Frame pipeline + fraud
+# ---------------------------------------------------------------------------
+
+
+def _fraud_frame(n=600, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 5).astype(np.float32)
+    w = rng.randn(5)
+    label = ((x @ w) > 1.2).astype(np.int64)   # imbalanced positives
+    return {
+        **{f"V{i}": x[:, i] for i in range(5)},
+        "label": label,
+        "time": np.arange(n, dtype=np.float64),
+    }
+
+
+def test_vector_assembler_and_scaler():
+    frame = _fraud_frame(100)
+    pipe = FramePipeline([
+        VectorAssembler([f"V{i}" for i in range(5)]),
+        StandardScaler(),
+    ])
+    out = pipe.fit(frame).transform(frame)
+    assert out["features"].shape == (100, 5)
+    np.testing.assert_allclose(out["features"].mean(0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(out["features"].std(0), 1.0, atol=1e-4)
+
+
+def test_func_transformer_label_remap():
+    frame = {"label": np.array([0, 2, 2, 0])}
+    out = FuncTransformer(lambda v: {0: 2, 2: 0}.get(v, v), "label").transform(frame)
+    assert out["label"].tolist() == [2, 0, 0, 2]
+
+
+def test_stratified_sampler():
+    frame = {"label": np.array([0] * 100 + [1] * 10),
+             "x": np.arange(110, dtype=np.float32)}
+    out = StratifiedSampler({0: 0.5, 1: 3.0}, seed=1).transform(frame)
+    labels = out["label"]
+    assert (labels == 0).sum() == 50
+    assert (labels == 1).sum() == 30
+
+
+def test_time_ordered_split():
+    frame = _fraud_frame(100)
+    train, test = time_ordered_split(frame, "time", 0.7)
+    assert len(train["label"]) == 71 or len(train["label"]) == 70
+    assert train["time"].max() < test["time"].min()
+
+
+def test_mlp_classifier_learns():
+    frame = _fraud_frame(600)
+    pipe = FramePipeline([
+        VectorAssembler([f"V{i}" for i in range(5)]),
+        StandardScaler(),
+    ])
+    frame = pipe.fit(frame).transform(frame)
+    clf = MLPClassifier(in_features=5, epochs=12, batch_size=64, lr=5e-3)
+    clf.fit(frame)
+    out = clf.transform(frame)
+    acc = (out["prediction"] == frame["label"]).mean()
+    assert acc > 0.85
+
+
+def test_bagging_votes():
+    frame = _fraud_frame(400)
+    frame = FramePipeline([
+        VectorAssembler([f"V{i}" for i in range(5)]),
+        StandardScaler(),
+    ]).fit(frame).transform(frame)
+    bag = Bagging(base_fn=lambda: MLPClassifier(in_features=5, epochs=6,
+                                                batch_size=64, lr=5e-3),
+                  n_models=3, threshold=2)
+    bag.fit(frame)
+    out = bag.transform(frame)
+    assert out["votes"].max() <= 3
+    acc = (out["prediction"] == frame["label"]).mean()
+    assert acc > 0.8
+
+
+def test_auprc_bounds():
+    labels = np.array([1, 1, 0, 0])
+    assert auprc(labels, np.array([0.9, 0.8, 0.2, 0.1])) == pytest.approx(1.0)
+    assert auprc(labels, np.array([0.1, 0.2, 0.8, 0.9])) < 0.6
+
+
+# ---------------------------------------------------------------------------
+# VOC parsing (reference PascalVocSpec)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_voc_annotation(tmp_path):
+    xml = textwrap.dedent("""\
+        <annotation>
+          <object><name>dog</name><difficult>0</difficult>
+            <bndbox><xmin>48</xmin><ymin>240</ymin><xmax>195</xmax><ymax>371</ymax></bndbox>
+          </object>
+          <object><name>person</name><difficult>1</difficult>
+            <bndbox><xmin>8</xmin><ymin>12</ymin><xmax>352</xmax><ymax>498</ymax></bndbox>
+          </object>
+        </annotation>""")
+    p = tmp_path / "000001.xml"
+    p.write_text(xml)
+    label = parse_voc_annotation(str(p))
+    assert label.size() == 2
+    assert label.labels[0] == VOC_CLASSES.index("dog")
+    assert label.difficult.tolist() == [0.0, 1.0]
+    np.testing.assert_allclose(label.bboxes[0], [48, 240, 195, 371])
+
+
+# ---------------------------------------------------------------------------
+# SSD data chain + predictor (tiny resolution for CPU speed)
+# ---------------------------------------------------------------------------
+
+
+def _fake_records(n=6, w=80, h=60):
+    rng = np.random.RandomState(0)
+    recs = []
+    for i in range(n):
+        img = (rng.rand(h, w, 3) * 255).astype(np.uint8)
+        ok, buf = cv2.imencode(".jpg", img)
+        gt = np.array([[1, 0, 10, 10, 50, 40],
+                       [2, 0, 30, 20, 70, 55]], np.float32)
+        recs.append(SSDByteRecord(data=buf.tobytes(), path=f"img{i}.jpg",
+                                  gt=gt))
+    return recs
+
+
+def test_ssd_train_set_batches(tmp_path):
+    recs = _fake_records(6)
+    write_ssd_records(recs, str(tmp_path / "train"), num_shards=2)
+    param = PreProcessParam(batch_size=2, resolution=96, max_gt=10)
+    ds = load_train_set(str(tmp_path / "*.azr"), param)
+    batches = list(ds)
+    assert len(batches) == 3
+    b = batches[0]
+    assert b["input"].shape == (2, 96, 96, 3)
+    assert b["target"]["bboxes"].shape == (2, 10, 4)
+    assert b["target"]["labels"].shape == (2, 10)
+    assert b["target"]["mask"].shape == (2, 10)
+    assert b["im_info"].shape == (2, 4)
+    # normalized gt
+    assert b["target"]["bboxes"].max() <= 1.0 + 1e-5
+
+
+def test_ssd_val_set_keeps_remainder(tmp_path):
+    recs = _fake_records(5)
+    write_ssd_records(recs, str(tmp_path / "val"), num_shards=1)
+    param = PreProcessParam(batch_size=2, resolution=96)
+    batches = list(load_val_set(str(tmp_path / "*.azr"), param))
+    assert sum(b["input"].shape[0] for b in batches) == 5
+
+
+def test_ssd_predictor_end_to_end(tmp_path):
+    recs = _fake_records(3)
+    param = PreProcessParam(batch_size=2, resolution=300)
+    model = Model(SSDVgg(num_classes=21, resolution=300))
+    model.build(0, jnp.zeros((1, 300, 300, 3)))
+    pred = SSDPredictor(model, param).set_top_k(10)
+    outs = pred.predict(recs)
+    assert len(outs) == 3
+    assert outs[0].shape == (10, 6)
+    # boxes are in original pixel space (<= max dim)
+    valid = outs[0][outs[0][:, 0] >= 0]
+    if len(valid):
+        assert valid[:, 2:].max() <= 80 + 1e-3
+
+
+# ---------------------------------------------------------------------------
+# DS2 pipeline
+# ---------------------------------------------------------------------------
+
+
+def test_ds2_pipeline_transcribe_and_rejoin():
+    model = make_ds2_model(hidden=32, n_rnn_layers=1, utt_length=100)
+    param = DS2Param(segment_seconds=1, batch_size=4)
+    # 2.5s utterance -> 3 segments; 1s utterance -> 1 segment
+    pipe = DeepSpeech2Pipeline(model, param)
+    rng = np.random.RandomState(0)
+    utts = {
+        "a": rng.randn(int(SAMPLE_RATE * 2.5)).astype(np.float32),
+        "b": rng.randn(SAMPLE_RATE).astype(np.float32),
+    }
+    out = pipe.transcribe_samples(utts)
+    assert set(out) == {"a", "b"}
+    assert all(isinstance(v, str) for v in out.values())
+    ev = pipe.evaluate(utts, {"a": "HELLO WORLD", "b": "TEST"})
+    assert 0.0 <= ev.cer
+    assert ev.wer > 0  # untrained model won't be right
+
+
+def test_ssd_map_validation_method_on_raw_output():
+    """SSDMeanAveragePrecision adapts raw (loc, conf) model output for the
+    Optimizer's validation loop (decode + NMS inside the method)."""
+    from analytics_zoo_tpu.pipelines import SSDMeanAveragePrecision
+    rng = np.random.RandomState(0)
+    P = 8732
+    loc = jnp.asarray(rng.randn(2, P, 4).astype(np.float32) * 0.1)
+    conf = jnp.asarray(rng.randn(2, P, 21).astype(np.float32))
+    batch = {"target": {
+        "bboxes": np.tile(np.asarray([0.2, 0.2, 0.7, 0.7], np.float32),
+                          (2, 3, 1)),
+        "labels": np.ones((2, 3), np.float32),
+        "mask": np.ones((2, 3), np.float32),
+    }}
+    m = SSDMeanAveragePrecision(n_classes=21)
+    res = m((loc, conf), batch)
+    assert 0.0 <= res.result() <= 1.0
+    merged = res + m((loc, conf), batch)
+    assert merged.npos[1] == 12
