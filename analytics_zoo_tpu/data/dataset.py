@@ -46,9 +46,15 @@ class DataSet:
     @staticmethod
     def from_record_files(pattern: str, decode_fn: Optional[Callable] = None,
                           shard_by_host: bool = True,
-                          shuffle_files: bool = False, seed: int = 0) -> "DataSet":
+                          shuffle_files: bool = False, seed: int = 0,
+                          native_threads: int = 0) -> "DataSet":
         """Sharded record-file source (the ``DataSet.rdd(sc.sequenceFile)``
-        equivalent, reference ``ssd/Utils.scala:37``)."""
+        equivalent, reference ``ssd/Utils.scala:37``).
+
+        ``native_threads > 0`` reads through the C++ threaded reader
+        (``data.native``) when built — higher IO throughput, but record
+        order across shards is then nondeterministic.
+        """
         if shard_by_host:
             paths = records_lib.shard_paths(pattern)
         else:
@@ -60,6 +66,14 @@ class DataSet:
             if shuffle_files:
                 random.Random(seed + state["epoch"]).shuffle(order)
                 state["epoch"] += 1
+            if native_threads > 0:
+                from analytics_zoo_tpu.data import native
+                if native.available():
+                    with native.NativeRecordReader(
+                            order, n_threads=native_threads) as reader:
+                        for payload in reader:
+                            yield decode_fn(payload) if decode_fn else payload
+                    return
             for p in order:
                 for payload in records_lib.read_records(p):
                     yield decode_fn(payload) if decode_fn else payload

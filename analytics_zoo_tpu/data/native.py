@@ -1,0 +1,203 @@
+"""ctypes bindings for the native data path (``native/azrecord.cpp``).
+
+The reference rides native code for its data hot loops (OpenCV JNI decode,
+SequenceFile IO — SURVEY.md §2.6); this module is the equivalent binding
+layer: a multithreaded C++ record reader and libjpeg BGR decode.  Every
+entry point degrades gracefully to the pure-Python implementations in
+``data.records`` / cv2 when the shared library isn't built, so the
+framework works everywhere and goes fast where the native lib exists.
+
+Build once per machine: ``make -C native`` or :func:`build`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libazrecord.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_missing = False   # negative probe cache: don't stat() per image
+
+
+def build(quiet: bool = True) -> str:
+    """Compile the native library (g++ + libjpeg, no external deps)."""
+    global _lib_missing
+    subprocess.run(["make", "-C", _NATIVE_DIR],
+                   check=True, capture_output=quiet)
+    _lib_missing = False
+    return _LIB_PATH
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_missing
+    if _lib is not None:
+        return _lib
+    if _lib_missing:
+        return None
+    if not os.path.exists(_LIB_PATH):
+        _lib_missing = True
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.az_reader_open.restype = ctypes.c_void_p
+    lib.az_reader_open.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int]
+    lib.az_reader_next.restype = ctypes.c_long
+    lib.az_reader_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+    lib.az_buffer_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    lib.az_reader_close.argtypes = [ctypes.c_void_p]
+    lib.az_decode_jpeg.restype = ctypes.c_int
+    lib.az_decode_jpeg.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_long,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.az_count_records.restype = ctypes.c_long
+    lib.az_count_records.argtypes = [ctypes.c_char_p]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeRecordReader:
+    """Threaded reader over sharded .azr files; yields payload bytes.
+
+    Intra-file record order is preserved per thread; cross-file order is
+    nondeterministic with ``n_threads > 1`` (fine for training; use one
+    thread for deterministic evaluation order).
+    """
+
+    def __init__(self, paths: Sequence[str], n_threads: int = 4,
+                 queue_capacity: int = 128):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                "native library not built — run make -C native or use the "
+                "pure-Python data.records reader")
+        self._lib = lib
+        arr = (ctypes.c_char_p * len(paths))(
+            *[p.encode("utf-8") for p in paths])
+        self._handle = lib.az_reader_open(arr, len(paths), n_threads,
+                                          queue_capacity)
+        if not self._handle:
+            raise ValueError("az_reader_open failed (no paths?)")
+
+    def __iter__(self) -> Iterator[bytes]:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        while True:
+            n = self._lib.az_reader_next(self._handle, ctypes.byref(out))
+            if n < 0:
+                return
+            try:
+                yield ctypes.string_at(out, n)
+            finally:
+                self._lib.az_buffer_free(out)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.az_reader_close(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _exif_orientation(data: bytes) -> int:
+    """EXIF Orientation tag (1..8; 1 = upright) from raw JPEG bytes."""
+    try:
+        if data[:2] != b"\xff\xd8":
+            return 1
+        i = 2
+        while i + 4 <= len(data):
+            if data[i] != 0xFF:
+                return 1
+            marker = data[i + 1]
+            if marker == 0xD9 or marker == 0xDA:
+                return 1
+            size = int.from_bytes(data[i + 2:i + 4], "big")
+            if marker == 0xE1 and data[i + 4:i + 10] == b"Exif\x00\x00":
+                tiff = i + 10
+                bo = "little" if data[tiff:tiff + 2] == b"II" else "big"
+                ifd = tiff + int.from_bytes(data[tiff + 4:tiff + 8], bo)
+                n = int.from_bytes(data[ifd:ifd + 2], bo)
+                for k in range(n):
+                    e = ifd + 2 + k * 12
+                    if int.from_bytes(data[e:e + 2], bo) == 0x0112:
+                        v = int.from_bytes(data[e + 8:e + 10], bo)
+                        return v if 1 <= v <= 8 else 1
+                return 1
+            i += 2 + size
+    except Exception:
+        pass
+    return 1
+
+
+def _apply_orientation(arr: np.ndarray, o: int) -> np.ndarray:
+    if o == 2:
+        return arr[:, ::-1]
+    if o == 3:
+        return arr[::-1, ::-1]
+    if o == 4:
+        return arr[::-1]
+    if o == 5:
+        return np.transpose(arr, (1, 0, 2))
+    if o == 6:
+        return np.rot90(arr, 3)
+    if o == 7:
+        return np.transpose(arr, (1, 0, 2))[::-1, ::-1]
+    if o == 8:
+        return np.rot90(arr, 1)
+    return arr
+
+
+def decode_jpeg(data: bytes) -> Optional[np.ndarray]:
+    """JPEG bytes → (H, W, 3) BGR uint8 via libjpeg; None on decode failure
+    or when the native lib is unavailable (callers fall back to cv2).
+
+    EXIF orientation is applied, matching cv2.imdecode's behavior so the
+    native and fallback paths produce identically-oriented mats.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    c = ctypes.c_int()
+    rc = lib.az_decode_jpeg(buf, len(data), ctypes.byref(out),
+                            ctypes.byref(w), ctypes.byref(h), ctypes.byref(c))
+    if rc != 0:
+        return None
+    try:
+        arr = np.ctypeslib.as_array(out, shape=(h.value, w.value, c.value))
+        orientation = _exif_orientation(data)
+        if orientation != 1:
+            return np.ascontiguousarray(_apply_orientation(arr, orientation))
+        return arr.copy()
+    finally:
+        lib.az_buffer_free(out)
+
+
+def count_records(path: str) -> int:
+    lib = _load()
+    if lib is None:
+        from analytics_zoo_tpu.data.records import read_records
+        return sum(1 for _ in read_records(path))
+    return int(lib.az_count_records(path.encode("utf-8")))

@@ -1,0 +1,158 @@
+"""Sequence/context parallelism: ring attention + sequence-sharded helpers.
+
+The reference's only long-sequence mechanism is data-level chunking
+(``TimeSegmenter.scala:11``: split audio into independent rows, re-join by
+``(audio_id, seq)`` — see SURVEY.md §5 "Long-context").  A TPU-native
+framework needs true *sequence parallelism*: shard the time axis T across
+the mesh's ``sequence`` axis and exchange blocks over ICI.
+
+This module provides:
+
+- :func:`ring_attention` — blockwise attention where K/V blocks rotate
+  around the ring via ``lax.ppermute`` while each device keeps a running
+  online-softmax (flash-attention style) over its local Q block.  Memory
+  per device is O(T/n · T/n) instead of O(T²); the n-step rotation overlaps
+  compute with ICI transfers.  Supports causal masking via global block
+  offsets.
+- :func:`shard_sequence` / :func:`unshard_sequence` — place (B, T, …)
+  activations on the sequence axis.
+- collective helpers (:func:`psum_mean`, :func:`ring_shift`) used by
+  sequence-parallel layers.
+
+All functions are built on ``shard_map`` over an explicit Mesh, so they
+compose with the data-parallel train step (mesh axes ``("data",
+"sequence")``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from analytics_zoo_tpu.parallel.mesh import SEQUENCE_AXIS
+
+NEG_INF = -1e30
+
+
+def shard_sequence(x, mesh: Mesh, axis_name: str = SEQUENCE_AXIS):
+    """Place (B, T, …) on the mesh with T sharded over ``axis_name``."""
+    spec = P(None, axis_name, *([None] * (np.ndim(x) - 2)))
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def unshard_sequence(x):
+    return jax.device_get(x)
+
+
+def psum_mean(x, axis_name: str):
+    """Mean across an axis's devices (gradient/metric reduction helper)."""
+    return jax.lax.psum(x, axis_name) / jax.lax.psum(1, axis_name)
+
+
+def ring_shift(x, axis_name: str, shift: int = 1):
+    """Rotate a block one hop around the ring (ppermute over ICI)."""
+    n = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
+                          scale: Optional[float]):
+    """Per-device body: q/k/v are LOCAL blocks (B, Tb, H, D)."""
+    B, Tb, H, D = q.shape
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    scale = scale if scale is not None else 1.0 / np.sqrt(D)
+
+    # accumulators in (B, H, Tq) layout for the online softmax
+    o = jnp.zeros((B, H, Tb, D), q.dtype)
+    l = jnp.zeros((B, H, Tb), jnp.float32)
+    m = jnp.full((B, H, Tb), NEG_INF, jnp.float32)
+    q_pos = my_idx * Tb + jnp.arange(Tb)                 # global q positions
+
+    def step(r, carry):
+        o, l, m, k_cur, v_cur = carry
+        # k_cur originated on device (my_idx - r) mod n
+        src = (my_idx - r) % n
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur) * scale
+        scores = scores.astype(jnp.float32)
+        if causal:
+            k_pos = src * Tb + jnp.arange(Tb)
+            mask = q_pos[:, None] >= k_pos[None, :]      # (Tq, Tk)
+            scores = jnp.where(mask[None, None], scores, NEG_INF)
+        blk_max = jnp.max(scores, axis=-1)               # (B, H, Tq)
+        new_m = jnp.maximum(m, blk_max)
+        p = jnp.exp(scores - new_m[..., None])
+        # rows with no valid key yet: new_m stays NEG_INF -> p would be
+        # exp(0)=1 garbage; zero them explicitly
+        p = jnp.where((new_m[..., None] > NEG_INF / 2), p, 0.0)
+        corr = jnp.where(m > NEG_INF / 2, jnp.exp(m - new_m), 0.0)
+        l = l * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v_cur.dtype), v_cur)
+        o = o * corr[..., None].astype(o.dtype) + pv
+        # rotate K/V one hop; after n steps every device saw every block
+        k_next = ring_shift(k_cur, axis_name)
+        v_next = ring_shift(v_cur, axis_name)
+        return o, l, m * 0 + new_m, k_next, v_next
+
+    o, l, m, _, _ = jax.lax.fori_loop(0, n, step, (o, l, m, k, v))
+    out = o / jnp.maximum(l, 1e-20)[..., None].astype(o.dtype)
+    return jnp.einsum("bhqd->bqhd", out)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str = SEQUENCE_AXIS,
+                   causal: bool = False, scale: Optional[float] = None):
+    """Sequence-parallel attention over a T-sharded batch.
+
+    q, k, v: (B, T, H, D) with T sharded over ``axis_name`` (use
+    :func:`shard_sequence`).  Returns (B, T, H, D), same sharding.  Inside
+    jit, XLA lowers the per-step ``ppermute`` to ICI sends overlapping the
+    per-block matmuls — the standard ring-attention schedule.
+    """
+    spec = P(None, axis_name, None, None)
+    body = functools.partial(_ring_attention_local, axis_name=axis_name,
+                             causal=causal, scale=scale)
+    try:
+        fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    except TypeError:  # older jax uses check_rep
+        fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_rep=False)
+    return fn(q, k, v)
+
+
+def full_attention(q, k, v, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Single-device reference implementation (for tests and small T)."""
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(D)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        Tq, Tk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.arange(Tq)[:, None] >= jnp.arange(Tk)[None, :]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+class RingAttentionLayer:
+    """Callable bundling a mesh + settings, usable as a model-side op for
+    long-context attention blocks (net-new capability vs the reference)."""
+
+    def __init__(self, mesh: Mesh, axis_name: str = SEQUENCE_AXIS,
+                 causal: bool = False):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.causal = causal
+
+    def __call__(self, q, k, v):
+        return ring_attention(q, k, v, self.mesh, self.axis_name, self.causal)

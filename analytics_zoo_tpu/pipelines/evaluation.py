@@ -48,25 +48,26 @@ def mark_tp_fp(det_boxes: np.ndarray, det_scores: np.ndarray,
     taken = np.zeros(len(gt_boxes), bool)
     out = np.zeros((len(det_boxes), 3), np.float32)
     off = 0.0 if normalized else 1.0
+    if len(gt_boxes):
+        # vectorized IoU matrix (numpy twin of ops.bbox.iou_matrix)
+        d, g = np.asarray(det_boxes, np.float64), np.asarray(gt_boxes, np.float64)
+        ix1 = np.maximum(d[:, None, 0], g[None, :, 0])
+        iy1 = np.maximum(d[:, None, 1], g[None, :, 1])
+        ix2 = np.minimum(d[:, None, 2], g[None, :, 2])
+        iy2 = np.minimum(d[:, None, 3], g[None, :, 3])
+        inter = (np.maximum(ix2 - ix1 + off, 0)
+                 * np.maximum(iy2 - iy1 + off, 0))
+        area_d = (d[:, 2] - d[:, 0] + off) * (d[:, 3] - d[:, 1] + off)
+        area_g = (g[:, 2] - g[:, 0] + off) * (g[:, 3] - g[:, 1] + off)
+        iou_all = inter / np.maximum(area_d[:, None] + area_g[None, :] - inter,
+                                     1e-12)
     for row, i in enumerate(order):
         out[row, 0] = det_scores[i]
-        best_iou, best_j = 0.0, -1
-        for j in range(len(gt_boxes)):
-            gx1, gy1, gx2, gy2 = gt_boxes[j]
-            x1 = max(det_boxes[i, 0], gx1)
-            y1 = max(det_boxes[i, 1], gy1)
-            x2 = min(det_boxes[i, 2], gx2)
-            y2 = min(det_boxes[i, 3], gy2)
-            iw, ih = max(x2 - x1 + off, 0), max(y2 - y1 + off, 0)
-            inter = iw * ih
-            if inter <= 0:
-                continue
-            a = ((det_boxes[i, 2] - det_boxes[i, 0] + off)
-                 * (det_boxes[i, 3] - det_boxes[i, 1] + off))
-            b = (gx2 - gx1 + off) * (gy2 - gy1 + off)
-            iou = inter / (a + b - inter)
-            if iou > best_iou:
-                best_iou, best_j = iou, j
+        if len(gt_boxes):
+            best_j = int(np.argmax(iou_all[i]))
+            best_iou = float(iou_all[i, best_j])
+        else:
+            best_iou, best_j = 0.0, -1
         if best_iou >= iou_threshold and best_j >= 0:
             if gt_difficult[best_j] > 0:
                 continue                       # difficult: ignore entirely
@@ -183,14 +184,17 @@ class PascalVocEvaluator:
                  class_names: Optional[Sequence[str]] = None):
         self.use_07_metric = "2007" in image_set
         self.class_names = class_names
-        self.method = None
 
     def evaluate(self, result: DetectionResult) -> float:
+        # the year decides the metric, overriding whatever the accumulating
+        # method defaulted to (reference picks 07 vs 10+ metric by year)
+        result.use_07_metric = self.use_07_metric
         aps = result.ap_per_class()
         names = self.class_names or [str(i) for i in range(len(aps))]
         for name, ap in zip(names[1:], aps[1:]):
             if not np.isnan(ap):
                 print(f"AP for {name} = {ap:.4f}")
-        m = result.result()
+        valid = ~np.isnan(aps)
+        m = float(aps[valid].mean()) if valid.any() else 0.0
         print(f"Mean AP = {m:.4f}")
         return m

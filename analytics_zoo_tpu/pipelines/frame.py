@@ -58,11 +58,17 @@ class FramePipeline(Stage):
         self.stages = list(stages)
 
     def fit(self, frame: Frame) -> "FramePipeline":
+        self.fit_transform(frame)
+        return self
+
+    def fit_transform(self, frame: Frame) -> Frame:
+        """Fit stages in order and return the final transformed frame —
+        avoids the second full pass a fit().transform() pair would cost."""
         cur = frame
         for s in self.stages:
             s.fit(cur)
             cur = s.transform(cur)
-        return self
+        return cur
 
     def transform(self, frame: Frame) -> Frame:
         cur = frame
@@ -202,6 +208,10 @@ class Bagging(Stage):
                 idx = rng.randint(0, n, size=n)   # bootstrap
                 sub = frame_select(frame, idx)
             m = self.base_fn()
+            # vary model init per sub-model — identical seeds would collapse
+            # the ensemble into near-copies and degenerate the vote
+            if hasattr(m, "seed"):
+                m.seed = self.seed + i
             m.fit(sub)
             self.models.append(m)
         return self

@@ -135,7 +135,7 @@ def run_fraud_pipeline(frame: Frame, feature_cols: Sequence[str],
         VectorAssembler(feature_cols),
         StandardScaler(),
     ])
-    frame = pre.fit(frame).transform(frame)
+    frame = pre.fit_transform(frame)
     train, test = time_ordered_split(frame, time_col)
 
     n_feat = np.asarray(frame["features"]).shape[1]

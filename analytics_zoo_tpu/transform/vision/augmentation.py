@@ -28,14 +28,28 @@ from analytics_zoo_tpu.transform.vision.image import FeatureTransformer, ImageFe
 class BytesToMat(FeatureTransformer):
     """Decode jpg/png bytes → BGR mat, recording original dims (reference
     ``Convertor.scala:24`` ``BytesToMat``); decode failure marks the
-    feature invalid (``:36-43``)."""
+    feature invalid (``:36-43``).
+
+    ``use_native=True`` (default) tries the libjpeg path from
+    ``data.native`` first (the OpenCV-JNI equivalent), falling back to cv2
+    for non-JPEG bytes or when the native lib isn't built.
+    """
+
+    def __init__(self, use_native: bool = True):
+        super().__init__()
+        self.use_native = use_native
 
     def transform(self, feature: ImageFeature) -> ImageFeature:
         if not feature.is_valid:
             return feature
         try:
-            buf = np.frombuffer(feature["bytes"], np.uint8)
-            mat = cv2.imdecode(buf, cv2.IMREAD_COLOR)
+            mat = None
+            if self.use_native:
+                from analytics_zoo_tpu.data import native
+                mat = native.decode_jpeg(feature["bytes"])
+            if mat is None:
+                buf = np.frombuffer(feature["bytes"], np.uint8)
+                mat = cv2.imdecode(buf, cv2.IMREAD_COLOR)
             if mat is None:
                 raise ValueError("imdecode failed")
             feature.mat = mat.astype(np.float32)

@@ -1,0 +1,149 @@
+"""Checkpoint import: name-keyed weight copy from external formats.
+
+The reference imports pretrained Caffe models two ways (``common/caffe/
+CaffeLoader.scala:68,561``): copy weights by layer name into an existing
+module (``load``) or build the graph from the prototxt (``loadCaffe``).
+The TPU equivalent: models here use Caffe-convention layer names
+(``conv1_1`` … ``fc7``, ``ssd.py``), so a **name-keyed dict of numpy
+arrays** is the interchange format.  Sources:
+
+- ``.npz`` archives (``caffemodel → npz`` via any external caffe-proto
+  dump; the generated protobuf bindings the reference bundles are a
+  missing blob there too, ``.MISSING_LARGE_BLOBS:2``);
+- torch ``state_dict``s (torchvision VGG16 backbones);
+- another model's params pytree.
+
+Layout conversion happens here: Caffe/torch convs are OIHW and Linears are
+(out, in); flax wants HWIO and (in, out).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Mapping, Optional, Tuple
+
+import numpy as np
+
+
+def flatten_params(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    """Params pytree → {'vgg/conv1_1/kernel': array, ...} (slash-joined)."""
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, Mapping):
+        for k, v in tree.items():
+            key = f"{prefix}/{k}" if prefix else str(k)
+            out.update(flatten_params(v, key))
+    else:
+        out[prefix] = np.asarray(tree)
+    return out
+
+
+def unflatten_params(flat: Mapping[str, np.ndarray]) -> Dict:
+    out: Dict = {}
+    for key, value in flat.items():
+        parts = key.split("/")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return out
+
+
+def conv_oihw_to_hwio(w: np.ndarray) -> np.ndarray:
+    """Caffe/torch conv kernel (O, I, H, W) → flax (H, W, I, O)."""
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+def linear_out_in_to_in_out(w: np.ndarray) -> np.ndarray:
+    return np.transpose(w, (1, 0))
+
+
+def load_weights_by_name(
+    params: Any,
+    source: Mapping[str, np.ndarray],
+    rename: Optional[Callable[[str], str]] = None,
+    convert_layouts: bool = True,
+    strict: bool = False,
+) -> Tuple[Any, Dict[str, list]]:
+    """Copy ``source`` arrays into a params pytree by leaf name.
+
+    Matching: each flattened param key (e.g. ``vgg/conv1_1/kernel``) is
+    looked up in ``source`` under (a) the full slash key, (b) the key with
+    ``kernel→weight`` torch naming, (c) the trailing ``layer/param`` pair —
+    mirroring the reference's by-layer-name ``copyParameters``
+    (``CaffeLoader.scala:234``).  ``rename`` pre-maps source keys.  Layouts
+    auto-convert when shapes say so (OIHW conv kernels, transposed dense).
+
+    Returns ``(new_params, report)`` with report keys ``loaded``,
+    ``missing`` (params with no source), ``unused`` (source keys never
+    consumed).  ``strict=True`` raises on missing.
+    """
+    src = {(rename(k) if rename else k): np.asarray(v)
+           for k, v in source.items()}
+    flat = flatten_params(params)
+    new_flat: Dict[str, np.ndarray] = {}
+    loaded, missing = [], []
+    used = set()
+
+    def candidates(key: str):
+        yield key
+        if key.endswith("/kernel"):
+            yield key[: -len("/kernel")] + "/weight"
+        parts = key.split("/")
+        if len(parts) >= 2:
+            tail = "/".join(parts[-2:])
+            yield tail
+            if tail.endswith("/kernel"):
+                yield tail[: -len("/kernel")] + "/weight"
+            yield ".".join(parts[-2:])
+            yield ".".join(parts[-2:]).replace("kernel", "weight")
+
+    for key, value in flat.items():
+        found = None
+        for cand in candidates(key):
+            if cand in src:
+                found = cand
+                break
+        if found is None:
+            new_flat[key] = value
+            missing.append(key)
+            continue
+        w = src[found]
+        if convert_layouts and w.shape != value.shape:
+            if w.ndim == 4 and conv_oihw_to_hwio(w).shape == value.shape:
+                w = conv_oihw_to_hwio(w)
+            elif w.ndim == 2 and w.T.shape == value.shape:
+                w = w.T
+        if w.shape != value.shape:
+            raise ValueError(
+                f"shape mismatch for {key}: param {value.shape} vs source "
+                f"{found} {w.shape}")
+        new_flat[key] = w.astype(value.dtype)
+        loaded.append(key)
+        used.add(found)
+
+    if strict and missing:
+        raise KeyError(f"no source weights for: {missing}")
+    report = {"loaded": loaded, "missing": missing,
+              "unused": [k for k in src if k not in used]}
+    return unflatten_params(new_flat), report
+
+
+def load_npz(path: str) -> Dict[str, np.ndarray]:
+    with np.load(path, allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def save_npz(path: str, params: Any) -> None:
+    """Export a params pytree as a flat npz (the portable checkpoint form;
+    orbax handles the full TrainState in ``parallel.checkpoint``)."""
+    np.savez(path, **flatten_params(params))
+
+
+def load_torch_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Read a torch .pt/.pth state dict into numpy (CPU torch is in the
+    image; used for torchvision VGG16 backbone import)."""
+    import torch
+
+    state = torch.load(path, map_location="cpu", weights_only=True)
+    if hasattr(state, "state_dict"):
+        state = state.state_dict()
+    return {k: v.numpy() for k, v in state.items()}

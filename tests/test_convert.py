@@ -1,0 +1,89 @@
+"""Weight-converter tests: name matching, layout conversion, npz round-trip,
+and a real partial import into SSDVgg."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from analytics_zoo_tpu.models import SSDVgg
+from analytics_zoo_tpu.utils.convert import (
+    conv_oihw_to_hwio,
+    flatten_params,
+    load_npz,
+    load_weights_by_name,
+    save_npz,
+    unflatten_params,
+)
+
+
+def test_flatten_roundtrip():
+    tree = {"a": {"b": np.ones(3), "c": {"d": np.zeros(2)}}}
+    flat = flatten_params(tree)
+    assert set(flat) == {"a/b", "a/c/d"}
+    back = unflatten_params(flat)
+    np.testing.assert_array_equal(back["a"]["c"]["d"], np.zeros(2))
+
+
+def test_npz_roundtrip(tmp_path):
+    tree = {"x": {"kernel": np.random.rand(3, 4).astype(np.float32)}}
+    p = str(tmp_path / "w.npz")
+    save_npz(p, tree)
+    back = load_npz(p)
+    np.testing.assert_array_equal(back["x/kernel"], tree["x"]["kernel"])
+
+
+def test_layout_conversion_oihw():
+    w = np.arange(2 * 3 * 5 * 7).reshape(2, 3, 5, 7).astype(np.float32)
+    h = conv_oihw_to_hwio(w)
+    assert h.shape == (5, 7, 3, 2)
+    assert h[0, 0, 0, 0] == w[0, 0, 0, 0]
+    assert h[1, 2, 1, 0] == w[0, 1, 1, 2]
+
+
+def test_load_by_name_with_tail_matching_and_transpose():
+    params = {
+        "net": {"fc": {"kernel": np.zeros((4, 8), np.float32),
+                       "bias": np.zeros(8, np.float32)}},
+    }
+    source = {
+        "fc/weight": np.ones((8, 4), np.float32),   # torch (out, in)
+        "fc/bias": np.full(8, 2.0, np.float32),
+    }
+    new, report = load_weights_by_name(params, source)
+    np.testing.assert_array_equal(new["net"]["fc"]["kernel"], np.ones((4, 8)))
+    np.testing.assert_array_equal(new["net"]["fc"]["bias"], np.full(8, 2.0))
+    assert not report["missing"]
+    assert not report["unused"]
+
+
+def test_load_by_name_strict_raises():
+    params = {"fc": {"kernel": np.zeros((2, 2), np.float32)}}
+    with pytest.raises(KeyError):
+        load_weights_by_name(params, {}, strict=True)
+
+
+def test_shape_mismatch_raises():
+    params = {"fc": {"kernel": np.zeros((2, 2), np.float32)}}
+    with pytest.raises(ValueError):
+        load_weights_by_name(params, {"fc/kernel": np.zeros((3, 5))})
+
+
+def test_partial_vgg_import_into_ssd():
+    """Caffe-style conv1_1 weights (OIHW) land in the SSD backbone by name."""
+    model = SSDVgg(num_classes=4, resolution=300)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 300, 300, 3)))
+    src = {
+        "conv1_1/weight": np.random.RandomState(0).rand(64, 3, 3, 3)
+                             .astype(np.float32),
+        "conv1_1/bias": np.zeros(64, np.float32),
+    }
+    new_params, report = load_weights_by_name(variables["params"], src)
+    assert "vgg/conv1_1/kernel" in report["loaded"]
+    assert "vgg/conv1_1/bias" in report["loaded"]
+    got = np.asarray(new_params["vgg"]["conv1_1"]["kernel"])
+    np.testing.assert_allclose(got, conv_oihw_to_hwio(src["conv1_1/weight"]))
+    # everything else untouched but present
+    assert "vgg/conv2_1/kernel" in report["missing"]
+    out = model.apply({"params": new_params}, jnp.zeros((1, 300, 300, 3)))
+    assert out[0].shape[0] == 1
