@@ -9,6 +9,13 @@ from analytics_zoo_tpu.models.ssd import (
     ssd300_config,
     ssd512_config,
 )
+from analytics_zoo_tpu.models.ssd_variants import (
+    SSDAlexNet,
+    SSDMobileNet,
+    alexnet_ssd_config,
+    mobilenet_ssd_config,
+    multibox_heads,
+)
 from analytics_zoo_tpu.models.deepspeech2 import DeepSpeech2, SequenceBN
 from analytics_zoo_tpu.models.simple import FraudMLP, NeuralCF, SentimentNet
 

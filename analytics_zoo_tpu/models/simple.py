@@ -88,8 +88,7 @@ class NeuralCF(nn.Module):
     n_classes: int = 5
 
     @nn.compact
-    def __call__(self, inputs):
-        users, items = inputs
+    def __call__(self, users, items):
         u = nn.Embed(self.n_users, self.embedding_dim, name="user_embed")(
             users.astype(jnp.int32))
         v = nn.Embed(self.n_items, self.embedding_dim, name="item_embed")(

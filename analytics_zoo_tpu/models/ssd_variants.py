@@ -1,0 +1,160 @@
+"""SSD backbone variants: AlexNet and MobileNet.
+
+The reference ships SSD over multiple backbones: ``SSDAlexNet.scala`` (300,
+pool6 head), ``SSDVggSeq.scala``, and a pretrained MobileNet-300-VOC model
+(``pipeline/ssd/README.md`` model zoo).  Same TPU-first design as
+``models.ssd``: NHWC convs, multibox heads as plain Python over the source
+list, priors as host constants derived from each variant's feature-map
+geometry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.models.ssd import SSDConfig, build_priors, num_priors_per_cell
+
+
+def alexnet_ssd_config() -> SSDConfig:
+    """AlexNet-SSD300: conv5 (18²) + 4 extra stages + global head."""
+    return SSDConfig(
+        resolution=300,
+        feature_shapes=(18, 9, 5, 3, 1),
+        min_sizes=(30, 78, 126, 174, 222),
+        max_sizes=(78, 126, 174, 222, 270),
+        aspect_ratios=((2,), (2, 3), (2, 3), (2,), (2,)),
+        steps=(17, 34, 60, 100, 300),
+    )
+
+
+def mobilenet_ssd_config() -> SSDConfig:
+    """MobileNet-SSD300 (chuanqi305-style scales)."""
+    return SSDConfig(
+        resolution=300,
+        feature_shapes=(19, 10, 5, 3, 2, 1),
+        min_sizes=(60, 105, 150, 195, 240, 285),
+        max_sizes=(105, 150, 195, 240, 285, 330),
+        aspect_ratios=((2,), (2, 3), (2, 3), (2, 3), (2, 3), (2, 3)),
+        steps=(16, 30, 60, 100, 150, 300),
+    )
+
+
+def multibox_heads(sources, priors_per_cell: Sequence[int],
+                   num_classes: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Shared loc/conf head plumbing over a source list (the reference's
+    ConcatTable/JoinTable assembly, ``SSD.scala:196,213``)."""
+    locs, confs = [], []
+    for i, (src, k) in enumerate(zip(sources, priors_per_cell)):
+        loc = nn.Conv(k * 4, (3, 3), padding=((1, 1), (1, 1)),
+                      name=f"loc_{i}")(src)
+        conf = nn.Conv(k * num_classes, (3, 3), padding=((1, 1), (1, 1)),
+                       name=f"conf_{i}")(src)
+        locs.append(loc.reshape(loc.shape[0], -1, 4))
+        confs.append(conf.reshape(conf.shape[0], -1, num_classes))
+    return jnp.concatenate(locs, axis=1), jnp.concatenate(confs, axis=1)
+
+
+class SSDAlexNet(nn.Module):
+    """AlexNet-backbone SSD300 (reference ``SSDAlexNet.scala``)."""
+
+    num_classes: int = 21
+
+    @property
+    def config(self) -> SSDConfig:
+        return alexnet_ssd_config()
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def conv(x, f, name, k=3, s=1, p=1):
+            return nn.relu(nn.Conv(f, (k, k), strides=(s, s),
+                                   padding=((p, p), (p, p)), name=name)(x))
+
+        x = conv(x, 64, "conv1", k=11, s=4, p=5)          # 75
+        x = nn.max_pool(x, (3, 3), (2, 2), padding=((0, 1), (0, 1)))  # 37
+        x = conv(x, 192, "conv2", k=5, p=2)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding=((0, 1), (0, 1)))  # 18
+        x = conv(x, 384, "conv3")
+        x = conv(x, 256, "conv4")
+        x = conv(x, 256, "conv5")
+        sources = [x]                                      # 18
+        x = conv(x, 512, "conv6_1", k=1, p=0)
+        x = conv(x, 512, "conv6_2", s=2)
+        sources.append(x)                                  # 9
+        x = conv(x, 128, "conv7_1", k=1, p=0)
+        x = conv(x, 256, "conv7_2", s=2)
+        sources.append(x)                                  # 5
+        x = conv(x, 128, "conv8_1", k=1, p=0)
+        x = conv(x, 256, "conv8_2", p=0)
+        sources.append(x)                                  # 3
+        x = jnp.mean(x, axis=(1, 2), keepdims=True)        # pool6 -> 1
+        sources.append(x)
+        return multibox_heads(sources, num_priors_per_cell(self.config),
+                              self.num_classes)
+
+
+class _DWSeparable(nn.Module):
+    """Depthwise-separable conv block (MobileNet unit)."""
+
+    features: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        in_ch = x.shape[-1]
+        x = nn.Conv(in_ch, (3, 3), strides=(self.stride, self.stride),
+                    padding=((1, 1), (1, 1)), feature_group_count=in_ch,
+                    name="dw")(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.features, (1, 1), name="pw")(x)
+        return nn.relu(x)
+
+
+class SSDMobileNet(nn.Module):
+    """MobileNet-backbone SSD300 (the reference model zoo's
+    MobileNet-300-VOC entry)."""
+
+    num_classes: int = 21
+    width_mult: float = 1.0
+
+    @property
+    def config(self) -> SSDConfig:
+        return mobilenet_ssd_config()
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        w = lambda f: max(int(f * self.width_mult), 8)
+        x = nn.relu(nn.Conv(w(32), (3, 3), strides=(2, 2),
+                            padding=((1, 1), (1, 1)), name="conv0")(x))  # 150
+        x = _DWSeparable(w(64), name="ds1")(x)
+        x = _DWSeparable(w(128), stride=2, name="ds2")(x)   # 75
+        x = _DWSeparable(w(128), name="ds3")(x)
+        x = _DWSeparable(w(256), stride=2, name="ds4")(x)   # 38
+        x = _DWSeparable(w(256), name="ds5")(x)
+        x = _DWSeparable(w(512), stride=2, name="ds6")(x)   # 19
+        for i in range(5):
+            x = _DWSeparable(w(512), name=f"ds7_{i}")(x)
+        sources = [x]                                       # conv11: 19
+        x = _DWSeparable(w(1024), stride=2, name="ds12")(x)  # 10
+        x = _DWSeparable(w(1024), name="ds13")(x)
+        sources.append(x)                                   # conv13: 10
+        def extra(x, f1, f2, name, stride=2, pad=1):
+            x = nn.relu(nn.Conv(f1, (1, 1), name=f"{name}_1")(x))
+            x = nn.relu(nn.Conv(f2, (3, 3), strides=(stride, stride),
+                                padding=((pad, pad), (pad, pad)),
+                                name=f"{name}_2")(x))
+            return x
+        x = extra(x, 256, 512, "conv14")                    # 5
+        sources.append(x)
+        x = extra(x, 128, 256, "conv15")                    # 3
+        sources.append(x)
+        x = extra(x, 128, 256, "conv16")                    # 2
+        sources.append(x)
+        x = extra(x, 64, 128, "conv17")                     # 1
+        sources.append(x)
+        return multibox_heads(sources, num_priors_per_cell(self.config),
+                              self.num_classes)

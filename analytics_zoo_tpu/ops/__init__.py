@@ -24,6 +24,7 @@ from analytics_zoo_tpu.ops.multibox_loss import (
     match_priors,
     multibox_loss,
 )
+from analytics_zoo_tpu.ops.frcnn import FrcnnPostParam, frcnn_postprocess
 from analytics_zoo_tpu.ops.anchor import generate_base_anchors, shift_anchors
 from analytics_zoo_tpu.ops.proposal import ProposalParam, proposal
 

@@ -1,0 +1,71 @@
+"""Faster-RCNN post-processing (reference ``common/nn/FrcnnPostprocessor.
+scala:40``): per-class NMS over the class-wise box/score heads, optional
+bbox voting (``BboxUtil.bboxVote:622``), and a global max-per-image cap.
+
+Jittable with static shapes: outputs are padded ``(max_per_image, 6)`` rows
+``(class, score, x1, y1, x2, y2)`` like DetectionOutput.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.ops.bbox import bbox_vote
+from analytics_zoo_tpu.ops.nms import nms
+
+
+@dataclasses.dataclass(frozen=True)
+class FrcnnPostParam:
+    n_classes: int = 21
+    nms_thresh: float = 0.3
+    conf_thresh: float = 0.05
+    bbox_vote: bool = False
+    max_per_image: int = 100
+    nms_topk: int = 300
+
+
+@partial(jax.jit, static_argnames=("param",))
+def frcnn_postprocess(scores: jax.Array, boxes: jax.Array,
+                      param: FrcnnPostParam = FrcnnPostParam()) -> jax.Array:
+    """scores (R, C) softmax probs, boxes (R, C·4) per-class regressed pixel
+    boxes (py-faster-rcnn layout) → (max_per_image, 6) detections."""
+    R, C = scores.shape
+    boxes_pc = boxes.reshape(R, C, 4)
+
+    def per_class(c_scores, c_boxes):
+        keep_idx, keep_mask = nms(
+            c_boxes, c_scores, iou_threshold=param.nms_thresh,
+            max_output=param.nms_topk, pre_topk=min(param.nms_topk, R),
+            score_threshold=param.conf_thresh, normalized=False)
+        safe = jnp.maximum(keep_idx, 0)
+        kept_boxes = c_boxes[safe]
+        kept_scores = c_scores[safe] * keep_mask
+        if param.bbox_vote:
+            voted = bbox_vote(kept_boxes, kept_scores, c_boxes, c_scores,
+                              jnp.ones((R,)), param.nms_thresh)
+            kept_boxes = voted
+        return kept_scores, kept_boxes
+
+    # vmap over classes (skip background column 0 by masking after)
+    s_t = scores.T                               # (C, R)
+    b_t = jnp.swapaxes(boxes_pc, 0, 1)           # (C, R, 4)
+    kept_scores, kept_boxes = jax.vmap(per_class)(s_t, b_t)  # (C, K)
+    cls_ids = jnp.arange(C)
+    fg = (cls_ids != 0).astype(jnp.float32)
+    kept_scores = kept_scores * fg[:, None]
+
+    flat_scores = kept_scores.reshape(-1)
+    flat_boxes = kept_boxes.reshape(-1, 4)
+    flat_cls = jnp.repeat(cls_ids, kept_scores.shape[1])
+    top_scores, order = jax.lax.top_k(flat_scores, param.max_per_image)
+    valid = top_scores > 0
+    out = jnp.concatenate([
+        jnp.where(valid, flat_cls[order], -1)[:, None].astype(jnp.float32),
+        top_scores[:, None],
+        jnp.where(valid[:, None], flat_boxes[order], 0.0),
+    ], axis=1)
+    return out

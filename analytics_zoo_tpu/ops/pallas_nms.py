@@ -31,7 +31,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _nms_kernel(x1_ref, y1_ref, x2_ref, y2_ref, valid_ref, keep_ref,
-                active_ref, *, iou_threshold: float, k: int):
+                active_ref, *, iou_threshold: float, k: int,
+                off: float):
     """One class: sweep sorted candidates, suppress by IoU.
 
     TPU VMEM has no scalar stores, so all per-candidate reads/writes are
@@ -59,10 +60,11 @@ def _nms_kernel(x1_ref, y1_ref, x2_ref, y2_ref, valid_ref, keep_ref,
             iy1 = jnp.maximum(y1_ref[:], by1)
             ix2 = jnp.minimum(x2_ref[:], bx2)
             iy2 = jnp.minimum(y2_ref[:], by2)
-            inter = (jnp.maximum(ix2 - ix1, 0.0)
-                     * jnp.maximum(iy2 - iy1, 0.0))
-            area = ((x2_ref[:] - x1_ref[:]) * (y2_ref[:] - y1_ref[:]))
-            area_i = (bx2 - bx1) * (by2 - by1)
+            inter = (jnp.maximum(ix2 - ix1 + off, 0.0)
+                     * jnp.maximum(iy2 - iy1 + off, 0.0))
+            area = ((x2_ref[:] - x1_ref[:] + off)
+                    * (y2_ref[:] - y1_ref[:] + off))
+            area_i = (bx2 - bx1 + off) * (by2 - by1 + off)
             union = jnp.maximum(area + area_i - inter, 1e-12)
             iou = inter / union
             # deactivate everything overlapping the kept box (including
@@ -76,10 +78,13 @@ def _nms_kernel(x1_ref, y1_ref, x2_ref, y2_ref, valid_ref, keep_ref,
 
 
 def nms_sweep(x1, y1, x2, y2, valid, iou_threshold: float = 0.45,
-              interpret: bool = False):
-    """(C, K) sorted per-class candidates → (C, K) keep mask."""
+              normalized: bool = True, interpret: bool = False):
+    """(C, K) sorted per-class candidates → (C, K) keep mask.
+    ``normalized=False`` uses the +1-pixel-width convention (matching
+    ``ops.bbox.iou_matrix``'s flag)."""
     C, K = x1.shape
-    kernel = functools.partial(_nms_kernel, iou_threshold=iou_threshold, k=K)
+    kernel = functools.partial(_nms_kernel, iou_threshold=iou_threshold, k=K,
+                               off=0.0 if normalized else 1.0)
     spec = pl.BlockSpec((1, K), lambda c: (c, 0), memory_space=pltpu.VMEM)
     return pl.pallas_call(
         kernel,
@@ -100,10 +105,12 @@ def _round_up(n: int, m: int) -> int:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("iou_threshold", "max_output", "pre_topk", "interpret"))
+    static_argnames=("iou_threshold", "max_output", "pre_topk",
+                     "normalized", "interpret"))
 def pallas_nms(boxes: jax.Array, scores: jax.Array,
                iou_threshold: float = 0.45, max_output: int = 200,
                pre_topk: int = 400, score_threshold: float = -1e30,
+               normalized: bool = True,
                interpret: bool = False) -> Tuple[jax.Array, jax.Array]:
     """Drop-in for ``ops.nms.nms`` (single class) backed by the kernel.
 
@@ -122,7 +129,7 @@ def pallas_nms(boxes: jax.Array, scores: jax.Array,
     valid = (top_scores > -jnp.inf).astype(jnp.float32)
     keep = nms_sweep(tb[None, :, 0], tb[None, :, 1], tb[None, :, 2],
                      tb[None, :, 3], valid[None], iou_threshold,
-                     interpret=interpret)[0]              # (K,)
+                     normalized=normalized, interpret=interpret)[0]  # (K,)
     # first max_output kept candidates, in sorted (score) order
     rank = jnp.cumsum(keep) - 1                           # rank among kept
     sel = (keep > 0) & (rank < max_output)

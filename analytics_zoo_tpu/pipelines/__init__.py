@@ -48,6 +48,7 @@ from analytics_zoo_tpu.pipelines.fraud import (
     precision_recall,
     run_fraud_pipeline,
 )
+from analytics_zoo_tpu.pipelines.visualizer import result_to_string, vis_detection
 from analytics_zoo_tpu.pipelines.deepspeech2 import (
     DS2Param,
     DeepSpeech2Pipeline,

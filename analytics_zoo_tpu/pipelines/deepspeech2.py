@@ -134,3 +134,27 @@ def make_ds2_model(hidden: int = 1024, n_rnn_layers: int = 3,
                               n_mels=n_mels))
     model.build(seed, jnp.zeros((1, utt_length, n_mels)))
     return model
+
+
+def train_ds2(model: Model, dataset, epochs: int = 10, lr: float = 3e-4,
+              mesh=None, checkpoint_path: Optional[str] = None):
+    """CTC training for DS2 — capability the reference lacks (its DS2 is
+    inference-only; SURVEY.md §2.3).  ``dataset`` yields batches
+    ``{"input": (B,T,n_mels), "labels": (B,L) int32, "label_mask": (B,L)}``.
+    """
+    from analytics_zoo_tpu.core.criterion import CTCCriterion
+    from analytics_zoo_tpu.parallel import Adam, Optimizer, Trigger, create_mesh
+
+    mesh = mesh or create_mesh()
+    ctc = CTCCriterion(blank_id=0)
+
+    def criterion(log_probs, batch):
+        return ctc(log_probs, batch["labels"],
+                   label_mask=batch.get("label_mask"))
+
+    opt = (Optimizer(model, dataset, criterion, mesh=mesh)
+           .set_optim_method(Adam(lr))
+           .set_end_when(Trigger.max_epoch(epochs)))
+    if checkpoint_path:
+        opt.set_checkpoint(checkpoint_path, Trigger.every_epoch())
+    return opt.optimize()

@@ -176,7 +176,8 @@ class Bagging(Stage):
     integer threshold (≥ t positive sub-votes → positive), regression
     averages.
 
-    ``base_fn() -> Stage`` must return a fresh estimator whose
+    ``base_fn() -> Stage`` (or ``base_fn(i) -> Stage``, receiving the
+    sub-model index for seeding) must return a fresh estimator whose
     ``transform`` adds ``prediction_col``.
     """
 
@@ -207,10 +208,16 @@ class Bagging(Stage):
             else:
                 idx = rng.randint(0, n, size=n)   # bootstrap
                 sub = frame_select(frame, idx)
-            m = self.base_fn()
+            import inspect
             # vary model init per sub-model — identical seeds would collapse
-            # the ensemble into near-copies and degenerate the vote
-            if hasattr(m, "seed"):
+            # the ensemble into near-copies and degenerate the vote; prefer
+            # passing the index into base_fn, fall back to a seed attribute
+            try:
+                takes_index = len(inspect.signature(self.base_fn).parameters) >= 1
+            except (TypeError, ValueError):
+                takes_index = False
+            m = self.base_fn(i) if takes_index else self.base_fn()
+            if not takes_index and hasattr(m, "seed"):
                 m.seed = self.seed + i
             m.fit(sub)
             self.models.append(m)

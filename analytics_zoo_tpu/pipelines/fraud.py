@@ -125,11 +125,22 @@ class FraudResult:
 def run_fraud_pipeline(frame: Frame, feature_cols: Sequence[str],
                        label_col: str = "label", time_col: str = "time",
                        n_models: int = 20,
-                       thresholds: Sequence[int] = range(20, 41),
-                       epochs: int = 5, mesh=None) -> FraudResult:
+                       thresholds: Optional[Sequence[int]] = None,
+                       epochs: int = 10, mesh=None) -> FraudResult:
     """End-to-end reference flow (``BigDLKaggleFraud.scala``): preprocess →
-    time split → Bagging(MLP) over stratified samples → threshold sweep."""
+    time split → Bagging(MLP) over stratified samples → threshold sweep
+    (reference sweeps 20..40 with 20 models; default here scales the sweep
+    to ``n_models`` so small ensembles stay meaningful)."""
     from analytics_zoo_tpu.pipelines.frame import Bagging
+
+    if thresholds is None:
+        thresholds = range(max(n_models // 2, 1), n_models + 1)
+    else:
+        thresholds = [t for t in thresholds if 1 <= t <= n_models]
+        if not thresholds:
+            raise ValueError(
+                f"no requested vote threshold lies in [1, {n_models}] — "
+                f"thresholds must not exceed n_models")
 
     pre = FramePipeline([
         VectorAssembler(feature_cols),

@@ -18,6 +18,7 @@ from analytics_zoo_tpu.transform.audio.decoders import (
     BLANK_ID,
     ASREvaluator,
     NGramDecoder,
+    TranscriptVectorizer,
     VocabDecoder,
     best_path_decode,
     cer,

@@ -121,6 +121,29 @@ class NGramDecoder:
         return " ".join(out)
 
 
+class TranscriptVectorizer:
+    """transcript → padded label-id vector for CTC training (reference
+    ``acoustic/TranscriptVectorizer.scala:11``, net-enabled here since this
+    framework trains DS2, not just serves it)."""
+
+    def __init__(self, alphabet: str = ALPHABET, max_length: int = 200):
+        self.alphabet = alphabet
+        self.index = {c: i for i, c in enumerate(alphabet)}
+        self.max_length = max_length
+
+    def __call__(self, transcript: str):
+        """Returns (ids (max_length,) int32, mask (max_length,) float32)."""
+        import numpy as _np
+
+        ids = [self.index[c] for c in transcript.upper() if c in self.index]
+        ids = ids[: self.max_length]
+        out = _np.zeros(self.max_length, _np.int32)
+        mask = _np.zeros(self.max_length, _np.float32)
+        out[: len(ids)] = ids
+        mask[: len(ids)] = 1.0
+        return out, mask
+
+
 class ASREvaluator:
     """Accumulating WER/CER over utterances (reference ``ASREvaluator``)."""
 

@@ -1,1 +1,3 @@
-"""Utilities: engine/topology init, checkpointing, summaries, config."""
+"""Utilities: engine/topology init, weight conversion, profiling."""
+
+from analytics_zoo_tpu.utils import convert, engine, profiling

@@ -107,7 +107,11 @@ def load_weights_by_name(
             missing.append(key)
             continue
         w = src[found]
-        if convert_layouts and w.shape != value.shape:
+        # a flax 'kernel' matched against a torch/caffe 'weight' is in the
+        # source framework's layout even when the shape happens to agree
+        # (square Linear, e.g. VGG fc7 4096x4096) — convert unconditionally
+        torch_named = key.endswith("/kernel") and "weight" in found
+        if convert_layouts and (torch_named or w.shape != value.shape):
             if w.ndim == 4 and conv_oihw_to_hwio(w).shape == value.shape:
                 w = conv_oihw_to_hwio(w)
             elif w.ndim == 2 and w.T.shape == value.shape:

@@ -1,0 +1,77 @@
+"""Profiling utilities: traces + step timing.
+
+The reference's tracing story (SURVEY.md §5): BigDL per-module
+``getTimes()`` aggregated by ``TestUtil.printModuleTime``, plus wall-clock
+throughput accumulators in ``Validator.test``.  TPU equivalents:
+
+- :func:`trace` — context manager around ``jax.profiler`` emitting a
+  TensorBoard-viewable trace (op-level timing replaces module-level);
+- :class:`StepTimer` — host-side per-step wall-clock accumulator with the
+  Validator-style "[N] in T seconds. Throughput is …" summary;
+- ``jax.named_scope`` re-exported as :func:`named_scope` so model code can
+  label regions that show up in traces (the ``getTimes`` analogue).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+named_scope = jax.named_scope
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a device trace viewable in TensorBoard's profile tab."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Accumulate per-step wall times + record counts; print throughput in
+    the reference Validator's format (``Validator.scala:82-86``)."""
+
+    def __init__(self, name: str = "train"):
+        self.name = name
+        self.times: List[float] = []
+        self.records = 0
+        self._t0: Optional[float] = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.times.append(time.perf_counter() - self._t0)
+
+    def step(self, n_records: int = 0):
+        """Use as ``with timer.step(n):`` — counts records too."""
+        self.records += n_records
+        return self
+
+    def summary(self) -> Dict[str, float]:
+        total = sum(self.times)
+        n = len(self.times)
+        out = {
+            "steps": n,
+            "total_s": total,
+            "mean_ms": (total / n * 1e3) if n else 0.0,
+            "records": self.records,
+            "records_per_sec": self.records / total if total else 0.0,
+        }
+        return out
+
+    def log(self) -> None:
+        s = self.summary()
+        logger.info("[%s] %d in %.2f seconds. Throughput is %.2f records/sec "
+                    "(%.1f ms/step)", self.name, s["records"], s["total_s"],
+                    s["records_per_sec"], s["mean_ms"])

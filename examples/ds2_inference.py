@@ -1,0 +1,68 @@
+"""DeepSpeech2 inference entry point (reference
+``deepspeech2/example/InferenceExample.scala`` + ``InferenceEvaluate.scala``):
+wav files → transcripts, or a LibriSpeech-style mapping file → WER/CER."""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description="DS2 transcription / evaluation")
+    p.add_argument("-d", "--data", required=True,
+                   help="wav file, folder of wavs, or mapping.txt "
+                        "(lines: <wav path>\\t<transcript>)")
+    p.add_argument("-m", "--model", default=None,
+                   help="Model.save() file (random weights if omitted)")
+    p.add_argument("-s", "--segment", type=int, default=30,
+                   help="segment seconds (reference TimeSegmenter)")
+    p.add_argument("-b", "--batch-size", type=int, default=8)
+    p.add_argument("--hidden", type=int, default=1024)
+    p.add_argument("--layers", type=int, default=3)
+    p.add_argument("--vocab", default=None, help="vocab.txt for VocabDecoder")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    from analytics_zoo_tpu.pipelines import (DS2Param, DeepSpeech2Pipeline,
+                                             make_ds2_model)
+    from analytics_zoo_tpu.transform.audio import read_audio
+
+    vocab = None
+    if args.vocab:
+        with open(args.vocab) as f:
+            vocab = [line.strip() for line in f if line.strip()]
+
+    model = make_ds2_model(hidden=args.hidden, n_rnn_layers=args.layers,
+                           utt_length=args.segment * 100)
+    if args.model:
+        model.load(args.model)
+    pipe = DeepSpeech2Pipeline(
+        model, DS2Param(segment_seconds=args.segment,
+                        batch_size=args.batch_size, vocab=vocab))
+
+    if os.path.isfile(args.data) and args.data.endswith(".txt"):
+        utts, refs = {}, {}
+        with open(args.data) as f:
+            for line in f:
+                path, ref = line.rstrip("\n").split("\t", 1)
+                utts[path], _ = read_audio(path)
+                refs[path] = ref
+        ev = pipe.evaluate(utts, refs)
+        print(f"WER = {ev.wer:.4f}  CER = {ev.cer:.4f}")
+        return
+
+    if os.path.isdir(args.data):
+        paths = sorted(os.path.join(args.data, q)
+                       for q in os.listdir(args.data)
+                       if q.lower().endswith((".wav", ".flac")))
+    else:
+        paths = [args.data]
+    for path, text in pipe.transcribe_files(paths).items():
+        print(f"{path}: {text}")
+
+
+if __name__ == "__main__":
+    main()

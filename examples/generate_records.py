@@ -1,0 +1,44 @@
+"""Dataset → sharded record files (reference
+``common/dataset/RoiImageSeqGenerator.scala:25`` CLI: imageset/folder →
+sequence files): VOC devkit or a plain image folder → .azr shards."""
+
+import argparse
+import glob
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description="Generate .azr record shards")
+    p.add_argument("-f", "--folder", required=True,
+                   help="VOCdevkit root (with --imageset) or image folder")
+    p.add_argument("-o", "--output", required=True, help="output prefix")
+    p.add_argument("-p", "--num-shards", type=int, default=8)
+    p.add_argument("--imageset", default=None,
+                   help="e.g. voc_2007_trainval (folder = VOCdevkit root)")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    from analytics_zoo_tpu.data import SSDByteRecord, write_ssd_records
+    from analytics_zoo_tpu.pipelines import get_imdb
+
+    if args.imageset:
+        dataset = get_imdb(args.imageset, args.folder)
+        records = list(dataset.load())
+    else:
+        records = []
+        for path in sorted(
+                q for ext in ("*.jpg", "*.jpeg", "*.png")
+                for q in glob.glob(os.path.join(args.folder, ext))):
+            with open(path, "rb") as f:
+                records.append(SSDByteRecord(data=f.read(), path=path))
+    paths = write_ssd_records(records, args.output, args.num_shards)
+    logging.info("wrote %d records into %d shards: %s …", len(records),
+                 len(paths), paths[0])
+
+
+if __name__ == "__main__":
+    main()

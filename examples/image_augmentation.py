@@ -1,0 +1,50 @@
+"""Image augmentation demo (reference ``apps/feature/image_augmentation.
+ipynb``): run each vision transformer on an input image and save the
+results side by side."""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description="Vision transformer demo")
+    p.add_argument("-f", "--image", required=True)
+    p.add_argument("-o", "--output-folder", default="aug_out")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import cv2
+
+    from analytics_zoo_tpu.transform.vision import (
+        Brightness, BytesToMat, CenterCrop, ChannelNormalize, ColorJitter,
+        Contrast, Expand, HFlip, Hue, ImageFeature, Resize, Saturation)
+
+    with open(args.image, "rb") as f:
+        data = f.read()
+
+    ops = {
+        "original": Resize(300, 300),
+        "brightness": Brightness(32, 32) >> Resize(300, 300),
+        "contrast": Contrast(1.5, 1.5) >> Resize(300, 300),
+        "saturation": Saturation(1.5, 1.5) >> Resize(300, 300),
+        "hue": Hue(18, 18) >> Resize(300, 300),
+        "hflip": HFlip() >> Resize(300, 300),
+        "expand": Expand(min_expand_ratio=2, max_expand_ratio=2) >> Resize(300, 300),
+        "center_crop": CenterCrop(200, 200) >> Resize(300, 300),
+        "color_jitter": ColorJitter() >> Resize(300, 300),
+    }
+    os.makedirs(args.output_folder, exist_ok=True)
+    for name, op in ops.items():
+        feat = BytesToMat().transform(ImageFeature(data, path=args.image))
+        feat = op.transform(feat)
+        out = os.path.join(args.output_folder, f"{name}.jpg")
+        cv2.imwrite(out, feat.mat.clip(0, 255).astype("uint8"))
+        logging.info("wrote %s", out)
+
+
+if __name__ == "__main__":
+    main()

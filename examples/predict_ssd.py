@@ -1,0 +1,70 @@
+"""SSD prediction entry point (reference ``ssd/example/Predict.scala``):
+image folder → detections → result txt and/or visualization."""
+
+import argparse
+import glob
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description="Run SSD detection on images")
+    p.add_argument("-f", "--image-folder", required=True)
+    p.add_argument("--model", required=True)
+    p.add_argument("-o", "--output-folder", default="ssd_out")
+    p.add_argument("-b", "--batch-size", type=int, default=8)
+    p.add_argument("-r", "--resolution", type=int, default=300)
+    p.add_argument("--class-number", type=int, default=21)
+    p.add_argument("--topk", type=int, default=200)
+    p.add_argument("--vis", action="store_true", help="save drawn images")
+    p.add_argument("--conf", type=float, default=0.3)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import cv2
+    import jax.numpy as jnp
+    import numpy as np
+
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.data import SSDByteRecord
+    from analytics_zoo_tpu.models import SSDVgg
+    from analytics_zoo_tpu.pipelines import (
+        PreProcessParam, SSDPredictor, result_to_string, vis_detection)
+
+    model = Model(SSDVgg(num_classes=args.class_number,
+                         resolution=args.resolution))
+    model.build(0, jnp.zeros((1, args.resolution, args.resolution, 3)))
+    model.load(args.model)
+
+    paths = sorted(
+        q for ext in ("*.jpg", "*.jpeg", "*.png")
+        for q in glob.glob(os.path.join(args.image_folder, ext)))
+    records = []
+    for path in paths:
+        with open(path, "rb") as f:
+            records.append(SSDByteRecord(data=f.read(), path=path))
+
+    predictor = SSDPredictor(
+        model, PreProcessParam(batch_size=args.batch_size,
+                               resolution=args.resolution),
+        n_classes=args.class_number).set_top_k(args.topk)
+    results = predictor.predict(records)
+
+    os.makedirs(args.output_folder, exist_ok=True)
+    for rec, dets in zip(records, results):
+        stem = os.path.splitext(os.path.basename(rec.path))[0]
+        with open(os.path.join(args.output_folder, stem + ".txt"), "w") as f:
+            f.write(result_to_string(dets, conf_thresh=args.conf))
+        if args.vis:
+            img = cv2.imread(rec.path)
+            vis_detection(img, dets, conf_thresh=args.conf,
+                          out_path=os.path.join(args.output_folder,
+                                                stem + "_det.jpg"))
+    logging.info("wrote %d results to %s", len(results), args.output_folder)
+
+
+if __name__ == "__main__":
+    main()

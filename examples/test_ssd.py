@@ -1,0 +1,48 @@
+"""SSD evaluation entry point (reference ``ssd/example/Test.scala:72-118``):
+records → Validator → per-class AP printout."""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description="Evaluate SSD mAP on records")
+    p.add_argument("-f", "--records", required=True)
+    p.add_argument("--model", required=True, help="Model.save() file")
+    p.add_argument("-b", "--batch-size", type=int, default=32)
+    p.add_argument("-r", "--resolution", type=int, default=300)
+    p.add_argument("--class-number", type=int, default=21)
+    p.add_argument("--image-set", default="voc_2007_test")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.models import SSDVgg
+    from analytics_zoo_tpu.pipelines import (
+        MeanAveragePrecision, PascalVocEvaluator, PreProcessParam,
+        VOC_CLASSES, Validator, load_val_set)
+
+    model = Model(SSDVgg(num_classes=args.class_number,
+                         resolution=args.resolution))
+    model.build(0, jnp.zeros((1, args.resolution, args.resolution, 3)))
+    model.load(args.model)
+
+    pre = PreProcessParam(batch_size=args.batch_size,
+                          resolution=args.resolution)
+    val_set = load_val_set(args.records, pre)
+    evaluator = MeanAveragePrecision(
+        n_classes=args.class_number,
+        use_07_metric="2007" in args.image_set,
+        class_names=VOC_CLASSES)
+    result = Validator(model, pre, evaluator).test(val_set)
+    PascalVocEvaluator(args.image_set, class_names=VOC_CLASSES).evaluate(result)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""SSD training entry point (reference ``ssd/example/Train.scala:64-136``
+scopt CLI, same knobs renamed to argparse)."""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description="Train SSD on VOC-style records")
+    p.add_argument("-f", "--train-records", required=True,
+                   help="glob of training .azr record shards")
+    p.add_argument("-v", "--val-records", default=None)
+    p.add_argument("-b", "--batch-size", type=int, default=32)
+    p.add_argument("-e", "--max-epoch", type=int, default=250)
+    p.add_argument("-l", "--learning-rate", type=float, default=0.0035)
+    p.add_argument("-r", "--resolution", type=int, default=300,
+                   choices=(300, 512))
+    p.add_argument("--class-number", type=int, default=21)
+    p.add_argument("--schedule", default="plateau",
+                   choices=("plateau", "multistep"))
+    p.add_argument("--lr-steps", type=int, nargs="*", default=[])
+    p.add_argument("--warmup-map", type=float, default=None,
+                   help="Adam warm-up until this mAP (Trigger.maxScore)")
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--no-overwrite-checkpoint", action="store_true")
+    p.add_argument("--summary-dir", default=None)
+    p.add_argument("--job-name", default="ssd300")
+    p.add_argument("--weights-npz", default=None,
+                   help="pretrained backbone weights (converter npz)")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    from analytics_zoo_tpu.pipelines import (
+        PreProcessParam, TrainParams, load_train_set, load_val_set, train_ssd)
+
+    pre = PreProcessParam(batch_size=args.batch_size,
+                          resolution=args.resolution)
+    train_set = load_train_set(args.train_records, pre)
+    val_set = (load_val_set(args.val_records, pre)
+               if args.val_records else None)
+    params = TrainParams(
+        batch_size=args.batch_size, resolution=args.resolution,
+        n_classes=args.class_number, learning_rate=args.learning_rate,
+        max_epoch=args.max_epoch, schedule=args.schedule,
+        lr_steps=args.lr_steps, warm_up_map=args.warmup_map,
+        checkpoint_path=args.checkpoint,
+        overwrite_checkpoint=not args.no_overwrite_checkpoint,
+        log_dir=args.summary_dir, job_name=args.job_name)
+
+    model = None
+    if args.weights_npz:
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.core.module import Model
+        from analytics_zoo_tpu.models import SSDVgg
+        from analytics_zoo_tpu.utils.convert import (load_npz,
+                                                     load_weights_by_name)
+        model = Model(SSDVgg(num_classes=args.class_number,
+                             resolution=args.resolution))
+        model.build(0, jnp.zeros((1, args.resolution, args.resolution, 3)))
+        new_params, report = load_weights_by_name(
+            model.variables["params"], load_npz(args.weights_npz))
+        logging.info("loaded %d tensors, %d missing", len(report["loaded"]),
+                     len(report["missing"]))
+        model.load_weights(new_params)
+
+    train_ssd(train_set, val_set, params, model=model)
+
+
+if __name__ == "__main__":
+    main()

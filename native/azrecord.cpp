@@ -19,6 +19,7 @@
 
 #include <jpeglib.h>
 
+#include <atomic>
 #include <condition_variable>
 #include <csetjmp>
 #include <cstdint>
@@ -97,14 +98,17 @@ class BoundedQueue {
 struct Reader {
   BoundedQueue queue;
   std::vector<std::thread> threads;
+  std::atomic<bool> cancelled{false};
   explicit Reader(size_t cap) : queue(cap) {}
 };
 
 // Read every record of one shard file, pushing payloads into the queue.
 // Truncated/corrupt files stop quietly at the damage point (the Python
 // layer surfaces counts; a bad shard must not kill the epoch — the same
-// contract as the vision pipeline's isValid flow).
-void read_file(const std::string& path, BoundedQueue* q) {
+// contract as the vision pipeline's isValid flow).  The cancellation flag
+// is checked per record so close() never waits for a full dataset scan.
+void read_file(const std::string& path, BoundedQueue* q,
+               const std::atomic<bool>* cancelled) {
   FILE* f = fopen(path.c_str(), "rb");
   if (!f) return;
   char magic[4];
@@ -113,6 +117,7 @@ void read_file(const std::string& path, BoundedQueue* q) {
     return;
   }
   for (;;) {
+    if (cancelled->load(std::memory_order_relaxed)) break;
     uint32_t len;
     if (fread(&len, 4, 1, f) != 1) break;
     uint8_t* buf = static_cast<uint8_t*>(malloc(len));
@@ -126,8 +131,12 @@ void read_file(const std::string& path, BoundedQueue* q) {
   fclose(f);
 }
 
-void reader_thread(std::vector<std::string> paths, BoundedQueue* q) {
-  for (const auto& p : paths) read_file(p, q);
+void reader_thread(std::vector<std::string> paths, BoundedQueue* q,
+                   const std::atomic<bool>* cancelled) {
+  for (const auto& p : paths) {
+    if (cancelled->load(std::memory_order_relaxed)) break;
+    read_file(p, q, cancelled);
+  }
   q->done_producer();
 }
 
@@ -157,7 +166,8 @@ void* az_reader_open(const char** paths, int n_paths, int n_threads,
   for (int i = 0; i < n_paths; ++i) buckets[i % n_threads].push_back(paths[i]);
   for (int t = 0; t < n_threads; ++t) r->queue.add_producer();
   for (int t = 0; t < n_threads; ++t) {
-    r->threads.emplace_back(reader_thread, buckets[t], &r->queue);
+    r->threads.emplace_back(reader_thread, buckets[t], &r->queue,
+                            &r->cancelled);
   }
   return r;
 }
@@ -176,6 +186,7 @@ void az_buffer_free(uint8_t* buf) { free(buf); }
 
 void az_reader_close(void* handle) {
   Reader* r = static_cast<Reader*>(handle);
+  r->cancelled.store(true);
   r->queue.close();
   for (auto& t : r->threads) t.join();
   delete r;

@@ -123,6 +123,6 @@ def test_neural_cf():
     m = NeuralCF(n_users=30, n_items=40)
     u = jnp.array([1, 2, 3])
     i = jnp.array([4, 5, 6])
-    v = m.init(jax.random.PRNGKey(0), (u, i))
-    out = m.apply(v, (u, i))
+    v = m.init(jax.random.PRNGKey(0), u, i)
+    out = m.apply(v, u, i)
     assert out.shape == (3, 5)

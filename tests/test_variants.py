@@ -1,0 +1,110 @@
+"""SSD backbone variants, Frcnn postprocessor, visualizer, vectorizer."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from analytics_zoo_tpu.models import (
+    SSDAlexNet,
+    SSDMobileNet,
+    alexnet_ssd_config,
+    build_priors,
+    mobilenet_ssd_config,
+    num_priors_per_cell,
+)
+from analytics_zoo_tpu.ops import FrcnnPostParam, frcnn_postprocess
+from analytics_zoo_tpu.pipelines import result_to_string, vis_detection
+from analytics_zoo_tpu.transform.audio import ALPHABET, TranscriptVectorizer
+
+
+def _prior_total(cfg):
+    per_cell = num_priors_per_cell(cfg)
+    return sum(k * f * f for k, f in zip(per_cell, cfg.feature_shapes))
+
+
+def test_ssd_alexnet_head_shapes_match_priors():
+    cfg = alexnet_ssd_config()
+    P = _prior_total(cfg)
+    priors, _ = build_priors(cfg)
+    assert priors.shape == (P, 4)
+    model = SSDAlexNet(num_classes=21)
+    x = jnp.zeros((1, 300, 300, 3))
+    v = model.init(jax.random.PRNGKey(0), x)
+    loc, conf = model.apply(v, x)
+    assert loc.shape == (1, P, 4)
+    assert conf.shape == (1, P, 21)
+
+
+def test_ssd_mobilenet_head_shapes_match_priors():
+    cfg = mobilenet_ssd_config()
+    P = _prior_total(cfg)
+    model = SSDMobileNet(num_classes=21, width_mult=0.25)
+    x = jnp.zeros((1, 300, 300, 3))
+    v = model.init(jax.random.PRNGKey(0), x)
+    loc, conf = model.apply(v, x)
+    assert loc.shape == (1, P, 4)
+    assert conf.shape == (1, P, 21)
+
+
+def test_frcnn_postprocess():
+    rng = np.random.RandomState(0)
+    R, C = 50, 4
+    scores = np.full((R, C), 0.01, np.float32)
+    scores[:, 0] = 0.9
+    # two strong rois for class 2, far apart
+    boxes = np.tile(rng.rand(R, 1, 2).repeat(2, 1).reshape(R, 4) * 50,
+                    (1, C)).astype(np.float32)
+    boxes[:, :] += np.tile([0, 0, 30, 30], C)
+    scores[5, 2] = 0.95
+    scores[20, 2] = 0.85
+    boxes[5, 8:12] = [0, 0, 30, 30]
+    boxes[20, 8:12] = [200, 200, 230, 230]
+    out = np.asarray(frcnn_postprocess(
+        jnp.asarray(scores), jnp.asarray(boxes),
+        FrcnnPostParam(n_classes=C, max_per_image=10, conf_thresh=0.5,
+                       nms_topk=50)))
+    valid = out[out[:, 0] >= 0]
+    assert valid.shape[0] == 2
+    assert (valid[:, 0] == 2).all()
+    assert valid[0, 1] == pytest.approx(0.95, abs=1e-5)
+
+
+def test_frcnn_bbox_vote_runs():
+    rng = np.random.RandomState(1)
+    scores = rng.rand(30, 3).astype(np.float32)
+    boxes = (rng.rand(30, 12) * 100).astype(np.float32)
+    boxes[:, 2::4] = boxes[:, 0::4] + 20
+    boxes[:, 3::4] = boxes[:, 1::4] + 20
+    out = frcnn_postprocess(jnp.asarray(scores), jnp.asarray(boxes),
+                            FrcnnPostParam(n_classes=3, bbox_vote=True,
+                                           max_per_image=5, nms_topk=30))
+    assert out.shape == (5, 6)
+
+
+def test_visualizer_draws_and_saves(tmp_path):
+    img = np.zeros((100, 120, 3), np.uint8)
+    dets = np.array([
+        [12, 0.9, 10, 10, 60, 60],      # dog
+        [-1, 0.0, 0, 0, 0, 0],          # padding
+        [15, 0.1, 0, 0, 5, 5],          # below conf thresh
+    ], np.float32)
+    out_path = str(tmp_path / "vis" / "out.jpg")
+    canvas = vis_detection(img, dets, conf_thresh=0.3, out_path=out_path)
+    assert canvas.shape == img.shape
+    assert canvas.sum() > 0                    # something was drawn
+    import os
+    assert os.path.exists(out_path)
+    txt = result_to_string(dets, conf_thresh=0.3)
+    assert txt.startswith("dog 0.9000")
+    assert "\n" not in txt                      # only one above threshold
+
+
+def test_transcript_vectorizer_roundtrip():
+    v = TranscriptVectorizer(max_length=20)
+    ids, mask = v("Hello World")
+    n = int(mask.sum())
+    assert n == len("HELLO WORLD")
+    back = "".join(ALPHABET[i] for i in ids[:n])
+    assert back == "HELLO WORLD"
+    assert (ids[n:] == 0).all()
