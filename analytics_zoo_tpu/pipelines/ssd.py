@@ -193,7 +193,7 @@ class SSDPredictor:
 
     def __init__(self, model: Model, param: PreProcessParam,
                  post: Optional[DetectionOutputParam] = None,
-                 n_classes: int = 21):
+                 n_classes: int = 21, compute_dtype=None):
         self.model = model
         self.param = param
         self.post = post or DetectionOutputParam(n_classes=n_classes)
@@ -201,7 +201,8 @@ class SSDPredictor:
             ssd300_config() if param.resolution == 300 else ssd512_config())
         self._priors = jnp.asarray(priors)
         self._variances = jnp.asarray(variances)
-        self._eval_step = make_eval_step(model.module)
+        self._eval_step = make_eval_step(model.module,
+                                         compute_dtype=compute_dtype)
 
     def set_top_k(self, k: int) -> "SSDPredictor":
         """Mutate keep_topk (reference ``setTopK`` mutating DetectionOutput)."""
@@ -310,6 +311,8 @@ class TrainParams:
     log_dir: Optional[str] = None
     job_name: str = "ssd300"
     max_gt: int = 100
+    # MXU-native mixed precision (fp32 masters, bf16 compute); None = fp32
+    compute_dtype: Optional[str] = "bf16"
 
 
 def train_ssd(train_set, val_set, params: TrainParams,
@@ -331,7 +334,8 @@ def train_ssd(train_set, val_set, params: TrainParams,
 
     def make_optimizer(optim_method, end_when):
         opt = (Optimizer(model, train_set, criterion, mesh=mesh,
-                         skip_loss_above=50.0)
+                         skip_loss_above=50.0,
+                         compute_dtype=params.compute_dtype)
                .set_optim_method(optim_method)
                .set_end_when(end_when))
         if val_set is not None:

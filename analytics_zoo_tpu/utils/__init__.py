@@ -1,3 +1,9 @@
 """Utilities: engine/topology init, weight conversion, profiling."""
 
-from analytics_zoo_tpu.utils import convert, engine, profiling
+from analytics_zoo_tpu.utils import (
+    caffe,
+    convert,
+    engine,
+    profiling,
+    protowire,
+)

@@ -1,0 +1,888 @@
+"""Caffe model import: ``.caffemodel`` / ``.prototxt`` → flax params or graphs.
+
+TPU-native re-design of the reference's Caffe importer family
+(``common/caffe/CaffeLoader.scala:68,561``, ``Converter.scala:42``,
+``LayerConverter.scala:39``, ``V1LayerConverter.scala:38``, plus the custom
+``PriorBoxConvertor.scala:28`` / ``PythonConverter.scala:28`` SSD layers).
+Two modes, mirroring the reference:
+
+- ``load`` — copy pretrained weights by layer name into an existing model
+  (``CaffeLoader.load`` → ``copyParameters``, ``CaffeLoader.scala:234``).
+  Here: ``read_caffemodel`` → ``caffe_weight_dict`` (name-keyed numpy) →
+  ``utils.convert.load_weights_by_name``.  This is the path the reference's
+  SSD training uses for pretrained VGG (``ssd/example/Train.scala:170``).
+- ``loadCaffe`` — build a runnable model *from* the net definition
+  (``CaffeLoader.createCaffeModel:579``).  Here: ``parse_prototxt`` →
+  ``build_caffe_graph`` assembles a flax module from a converter registry
+  (``CAFFE_CONVERTERS``), with the SSD fork's custom layers (Normalize,
+  PriorBox, DetectionOutput, Permute) mapped onto this framework's native
+  TPU ops instead of emulating Caffe tensor layouts.
+
+Layout note: Caffe is NCHW; this framework is NHWC (TPU-friendly).  The
+builder runs feature maps physically NHWC and tracks each tensor's
+*logical* layout so NCHW-semantic ops (Flatten, Reshape, Permute, axis'd
+Concat/Softmax) reproduce Caffe's element ordering exactly — e.g. the SSD
+``Permute(0,2,3,1) → Flatten`` head pattern becomes a plain NHWC flatten.
+
+No protobuf bindings are required: parsing uses the wire-format codec in
+``utils.protowire`` (the reference's generated ``Caffe.java`` is a missing
+blob there, ``.MISSING_LARGE_BLOBS:2``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.utils import protowire as pw
+
+# ---------------------------------------------------------------------------
+# caffemodel (binary) parsing
+# ---------------------------------------------------------------------------
+
+# V1LayerParameter.LayerType enum → readable type string (upstream caffe.proto
+# enum values; only informational — weight copy is keyed by layer *name*).
+_V1_LAYER_TYPES = {
+    0: "None", 1: "Accuracy", 2: "BNLL", 3: "Concat", 4: "Convolution",
+    5: "Data", 6: "Dropout", 7: "EuclideanLoss", 8: "Flatten", 9: "HDF5Data",
+    10: "HDF5Output", 11: "Im2col", 12: "ImageData", 13: "InfogainLoss",
+    14: "InnerProduct", 15: "LRN", 16: "MultinomialLogisticLoss",
+    17: "Pooling", 18: "ReLU", 19: "Sigmoid", 20: "Softmax",
+    21: "SoftmaxWithLoss", 22: "Split", 23: "TanH", 24: "WindowData",
+    25: "Eltwise", 26: "Power", 27: "SigmoidCrossEntropyLoss",
+    28: "HingeLoss", 29: "MemoryData", 30: "ArgMax", 31: "Threshold",
+    32: "DummyData", 33: "Slice", 34: "MVN", 35: "AbsVal", 36: "Silence",
+    37: "ContrastiveLoss", 38: "Exp", 39: "Deconvolution",
+}
+
+
+@dataclasses.dataclass
+class CaffeLayer:
+    """One parsed layer: identity + learned blobs (numpy, caffe layouts)."""
+
+    name: str
+    type: str
+    bottoms: List[str] = dataclasses.field(default_factory=list)
+    tops: List[str] = dataclasses.field(default_factory=list)
+    blobs: List[np.ndarray] = dataclasses.field(default_factory=list)
+    phase: Optional[int] = None  # 0 = TRAIN, 1 = TEST
+
+
+@dataclasses.dataclass
+class CaffeNet:
+    name: str = ""
+    layers: List[CaffeLayer] = dataclasses.field(default_factory=list)
+
+    def layer(self, name: str) -> CaffeLayer:
+        for l in self.layers:
+            if l.name == name:
+                return l
+        raise KeyError(name)
+
+
+def _parse_blob(buf) -> np.ndarray:
+    """BlobProto → ndarray (shape from BlobShape, else legacy NCHW dims)."""
+    shape: List[int] = []
+    legacy = [0, 0, 0, 0]  # num, channels, height, width
+    data: Optional[np.ndarray] = None
+    loose: List[float] = []
+    for field, wire, value in pw.iter_fields(buf):
+        if field == 7 and wire == pw.WIRETYPE_LEN:  # shape
+            for f2, w2, v2 in pw.iter_fields(value):
+                if f2 == 1:
+                    if w2 == pw.WIRETYPE_LEN:
+                        shape.extend(pw.packed_varints(v2))
+                    else:
+                        shape.append(int(v2))
+        elif field == 5:  # data (repeated float)
+            if wire == pw.WIRETYPE_LEN:
+                data = pw.packed_floats(value)
+            else:
+                loose.append(pw.fixed32_float(value))
+        elif field == 8 and wire == pw.WIRETYPE_LEN:  # double_data
+            data = pw.packed_doubles(value).astype(np.float32)
+        elif field in (1, 2, 3, 4) and wire == pw.WIRETYPE_VARINT:
+            legacy[field - 1] = int(value)
+    if data is None:
+        data = np.asarray(loose, dtype=np.float32)
+    if not shape:
+        # legacy pre-BlobShape header: always 4-D num/channels/height/width
+        # (vectors arrive as (1,1,1,N), FC weights as (1,1,out,in) —
+        # canonicalized per layer type in caffe_weight_dict)
+        shape = [d for d in legacy if d] or [data.size]
+    return np.asarray(data, dtype=np.float32).reshape(shape)
+
+
+def _parse_layer(buf, v1: bool) -> CaffeLayer:
+    layer = CaffeLayer(name="", type="")
+    name_f, type_f, bottom_f, top_f, blobs_f = (
+        (4, 5, 2, 3, 6) if v1 else (1, 2, 3, 4, 7))
+    for field, wire, value in pw.iter_fields(buf):
+        if field == name_f:
+            layer.name = pw.as_string(value)
+        elif field == type_f:
+            if v1:
+                layer.type = _V1_LAYER_TYPES.get(int(value), f"V1_{value}")
+            else:
+                layer.type = pw.as_string(value)
+        elif field == bottom_f:
+            layer.bottoms.append(pw.as_string(value))
+        elif field == top_f:
+            layer.tops.append(pw.as_string(value))
+        elif field == blobs_f:
+            layer.blobs.append(_parse_blob(value))
+        elif not v1 and field == 10 and wire == pw.WIRETYPE_VARINT:
+            layer.phase = int(value)
+    return layer
+
+
+def parse_net_parameter(buf: bytes) -> CaffeNet:
+    """NetParameter bytes → CaffeNet (handles V1 ``layers`` and V2 ``layer``)."""
+    net = CaffeNet()
+    for field, wire, value in pw.iter_fields(buf):
+        if field == 1 and wire == pw.WIRETYPE_LEN:
+            net.name = pw.as_string(value)
+        elif field == 2 and wire == pw.WIRETYPE_LEN:  # V1 layers
+            net.layers.append(_parse_layer(value, v1=True))
+        elif field == 100 and wire == pw.WIRETYPE_LEN:  # V2 layer
+            net.layers.append(_parse_layer(value, v1=False))
+    return net
+
+
+def read_caffemodel(path: str) -> CaffeNet:
+    with open(path, "rb") as f:
+        return parse_net_parameter(f.read())
+
+
+def save_caffemodel(path: str, net: CaffeNet, v1: bool = False) -> None:
+    """Write a NetParameter binary (tests + export back to Caffe format)."""
+    enc = pw.Encoder()
+    if net.name:
+        enc.string(1, net.name)
+    for layer in net.layers:
+        sub = pw.Encoder()
+        if v1:
+            for b in layer.bottoms:
+                sub.string(2, b)
+            for t in layer.tops:
+                sub.string(3, t)
+            sub.string(4, layer.name)
+            type_ids = {v: k for k, v in _V1_LAYER_TYPES.items()}
+            if layer.type not in type_ids:
+                raise ValueError(
+                    f"layer type {layer.type!r} has no V1 enum value "
+                    f"(SSD-fork layers require v1=False)")
+            sub.varint(5, type_ids[layer.type])
+            blob_field = 6
+        else:
+            sub.string(1, layer.name)
+            sub.string(2, layer.type)
+            for b in layer.bottoms:
+                sub.string(3, b)
+            for t in layer.tops:
+                sub.string(4, t)
+            blob_field = 7
+        for blob in layer.blobs:
+            benc = pw.Encoder()
+            shape_enc = pw.Encoder().packed_varints(1, blob.shape)
+            benc.message(7, shape_enc)
+            benc.packed_floats(5, np.asarray(blob, np.float32).ravel())
+            sub.message(blob_field, benc)
+        enc.message(2 if v1 else 100, sub)
+    with open(path, "wb") as f:
+        f.write(enc.tobytes())
+
+
+# ---------------------------------------------------------------------------
+# prototxt (protobuf text format) parsing
+# ---------------------------------------------------------------------------
+
+
+def _tokenize_prototxt(text: str) -> List[str]:
+    tokens: List[str] = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "#":
+            while i < n and text[i] != "\n":
+                i += 1
+        elif c in " \t\r\n,;":
+            i += 1
+        elif c in "{}:":
+            tokens.append(c)
+            i += 1
+        elif c == '"' or c == "'":
+            q = c
+            i += 1
+            start = i
+            out = []
+            while i < n and text[i] != q:
+                if text[i] == "\\" and i + 1 < n:
+                    out.append(text[start:i])
+                    i += 1
+                    out.append(text[i])
+                    start = i + 1
+                i += 1
+            out.append(text[start:i])
+            tokens.append('"' + "".join(out))
+            i += 1
+        else:
+            start = i
+            while i < n and text[i] not in " \t\r\n,;{}:#":
+                i += 1
+            tokens.append(text[start:i])
+    return tokens
+
+
+def _coerce(tok: str) -> Any:
+    if tok.startswith('"'):
+        return tok[1:]
+    low = tok.lower()
+    if low == "true":
+        return True
+    if low == "false":
+        return False
+    try:
+        return int(tok)
+    except ValueError:
+        pass
+    try:
+        return float(tok)
+    except ValueError:
+        pass
+    return tok  # enum identifier (MAX, TEST, ...)
+
+
+def _parse_message(tokens: List[str], pos: int) -> Tuple[Dict[str, Any], int]:
+    msg: Dict[str, Any] = {}
+
+    def put(key: str, value: Any) -> None:
+        if key in msg:
+            if not isinstance(msg[key], list):
+                msg[key] = [msg[key]]
+            msg[key].append(value)
+        else:
+            msg[key] = value
+
+    n = len(tokens)
+    while pos < n:
+        tok = tokens[pos]
+        if tok == "}":
+            return msg, pos + 1
+        key = tok
+        pos += 1
+        if pos < n and tokens[pos] == ":":
+            pos += 1
+        if pos < n and tokens[pos] == "{":
+            sub, pos = _parse_message(tokens, pos + 1)
+            put(key, sub)
+        else:
+            put(key, _coerce(tokens[pos]))
+            pos += 1
+    return msg, pos
+
+
+def parse_prototxt(text_or_path: str) -> Dict[str, Any]:
+    """Protobuf text format → nested dict; repeated keys become lists.
+
+    Equivalent of the reference's prototxt read
+    (``CaffeLoader.scala`` ``loadBinary``/text path).
+    """
+    text = text_or_path
+    if "\n" not in text_or_path and (
+            text_or_path.endswith(".prototxt") or text_or_path.endswith(".txt")):
+        with open(text_or_path) as f:
+            text = f.read()
+    msg, _ = _parse_message(_tokenize_prototxt(text), 0)
+    return msg
+
+
+def _aslist(v: Any) -> List[Any]:
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+def net_layers(netdef: Mapping[str, Any]) -> List[Dict[str, Any]]:
+    """Layer dicts of a parsed prototxt (V2 ``layer`` or V1 ``layers``)."""
+    return _aslist(netdef.get("layer") or netdef.get("layers"))
+
+
+# ---------------------------------------------------------------------------
+# weight extraction ("load" mode)
+# ---------------------------------------------------------------------------
+
+
+def caffe_weight_dict(net: CaffeNet) -> Dict[str, np.ndarray]:
+    """Name-keyed weight dict for ``utils.convert.load_weights_by_name``.
+
+    Per-type blob conventions (reference ``LayerConverter.scala`` copies the
+    same positions): Convolution/InnerProduct/Deconvolution → weight[, bias];
+    BatchNorm → moving mean/var rescaled by the scale factor blob;
+    Scale → scale[, bias]; Normalize (SSD fork) → per-channel scale vector.
+    """
+    out: Dict[str, np.ndarray] = {}
+    for layer in net.layers:
+        if not layer.blobs:
+            continue
+        name, t = layer.name, layer.type
+        blobs = layer.blobs
+        if t in ("Convolution", "Deconvolution"):
+            out[f"{name}/weight"] = blobs[0]
+            if len(blobs) > 1:
+                out[f"{name}/bias"] = blobs[1].ravel()
+        elif t == "InnerProduct":
+            w = blobs[0]
+            # legacy V1 blobs carry FC weights as (1,1,out,in)
+            out[f"{name}/weight"] = w.reshape(w.shape[-2], w.shape[-1])
+            if len(blobs) > 1:
+                out[f"{name}/bias"] = blobs[1].ravel()
+        elif t == "BatchNorm":
+            factor = float(blobs[2].ravel()[0]) if len(blobs) > 2 else 1.0
+            inv = 0.0 if factor == 0 else 1.0 / factor
+            out[f"{name}/moving_mean"] = blobs[0].ravel() * inv
+            out[f"{name}/moving_var"] = blobs[1].ravel() * inv
+        elif t == "Scale":
+            out[f"{name}/scale"] = blobs[0].ravel()
+            if len(blobs) > 1:
+                out[f"{name}/bias"] = blobs[1].ravel()
+        elif t == "Normalize":
+            out[f"{name}/scale"] = blobs[0].ravel()
+        else:
+            for i, b in enumerate(blobs):
+                out[f"{name}/blob_{i}"] = b
+    return out
+
+
+def ssd_vgg_rename(resolution: int = 300) -> Callable[[str], str]:
+    """Source-key rename: Caffe-SSD layer names → this framework's SSDVgg.
+
+    The Caffe SSD nets name their heads ``{source}_mbox_loc/conf`` over
+    sources (conv4_3_norm, fc7, conv6_2, …); ``models.ssd.SSDVgg`` names
+    them ``loc_{i}``/``conf_{i}`` and puts the conv4_3 L2-scale under
+    ``conv4_3_norm/cmul/weight`` (reference name tables:
+    ``ssd/model/SSDVgg.scala:58-70``, converter registration
+    ``CaffeLoader.scala:588``).
+    """
+    sources = ["conv4_3_norm", "fc7", "conv6_2", "conv7_2", "conv8_2",
+               "conv9_2"]
+    if resolution == 512:
+        sources.append("conv10_2")
+    mapping: Dict[str, str] = {"conv4_3_norm/scale": "conv4_3_norm/cmul/weight"}
+    for i, s in enumerate(sources):
+        for kind in ("weight", "bias"):
+            mapping[f"{s}_mbox_loc/{kind}"] = f"loc_{i}/{kind}"
+            mapping[f"{s}_mbox_conf/{kind}"] = f"conf_{i}/{kind}"
+
+    def rename(key: str) -> str:
+        return mapping.get(key, key)
+
+    return rename
+
+
+def load_caffe_weights(
+    params: Any,
+    caffemodel_path: str,
+    rename: Optional[Callable[[str], str]] = None,
+    strict: bool = False,
+) -> Tuple[Any, Dict[str, list]]:
+    """``CaffeLoader.load`` equivalent: weights-by-name into existing params."""
+    from analytics_zoo_tpu.utils.convert import load_weights_by_name
+
+    net = read_caffemodel(caffemodel_path)
+    return load_weights_by_name(
+        params, caffe_weight_dict(net), rename=rename, strict=strict)
+
+
+def load_ssd_vgg_caffe(params: Any, caffemodel_path: str,
+                       resolution: int = 300,
+                       strict: bool = False) -> Tuple[Any, Dict[str, list]]:
+    """Pretrained Caffe-SSD weights → ``models.ssd.SSDVgg`` params
+    (the reference Train path ``ssd/example/Train.scala:170``)."""
+    return load_caffe_weights(params, caffemodel_path,
+                              rename=ssd_vgg_rename(resolution), strict=strict)
+
+
+# ---------------------------------------------------------------------------
+# graph building ("loadCaffe" mode)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class _Spec:
+    """Static per-layer build spec (captured by closure in the built module)."""
+
+    name: str
+    type: str
+    bottoms: Tuple[str, ...]
+    tops: Tuple[str, ...]
+    params: Mapping[str, Any]
+
+
+def _layer_specs(netdef: Mapping[str, Any]) -> List[_Spec]:
+    specs = []
+    for ld in net_layers(netdef):
+        phase = None
+        for rule in _aslist(ld.get("include")):
+            if isinstance(rule, Mapping) and "phase" in rule:
+                phase = rule["phase"]
+        if phase == "TRAIN":
+            continue  # deploy graphs keep TEST + phase-less layers
+        specs.append(_Spec(
+            name=str(ld.get("name", "")),
+            type=str(ld.get("type", "")),
+            bottoms=tuple(_aslist(ld.get("bottom"))),
+            tops=tuple(_aslist(ld.get("top"))),
+            params=ld,
+        ))
+    return specs
+
+
+def _map_axis(axis: int, layout: str, ndim: int) -> int:
+    """Caffe (NCHW-semantic) axis → physical axis of our tensor."""
+    if axis < 0:
+        axis += ndim
+    if layout == "nhwc" and ndim == 4:
+        return {0: 0, 1: 3, 2: 1, 3: 2}[axis]
+    return axis
+
+
+class _Priors(tuple):
+    """Marker type: (priors (P,4), variances (P,4)) flowing through the graph."""
+
+
+def build_caffe_graph(netdef: Mapping[str, Any],
+                      custom: Optional[Mapping[str, Callable]] = None):
+    """Parsed deploy prototxt → flax module (``CaffeLoader.createCaffeModel``).
+
+    Returns a module whose ``__call__(x)`` takes NHWC input and returns the
+    final top (or a tuple when several tops are unconsumed).  Layer weights
+    are flax params named after the Caffe layer, so
+    ``load_caffe_weights(module.init(...)["params"], model.caffemodel)``
+    restores pretrained weights.
+
+    ``custom`` extends/overrides the converter registry, mirroring the
+    reference's per-loader converter customization
+    (``SSDCaffeLoader``/``FrcnnCaffeLoader``, ``CaffeLoader.scala:588,599``).
+    """
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.core import layers as L
+    from analytics_zoo_tpu.ops.detection_output import (
+        DetectionOutputParam, detection_output)
+    from analytics_zoo_tpu.ops.priorbox import PriorBoxParam, prior_box
+
+    specs = _layer_specs(netdef)
+    input_names = set(_aslist(netdef.get("input")))
+    registry: Dict[str, Callable] = dict(_CONVERTERS)
+    if custom:
+        registry.update(custom)
+
+    skip_types = ("Input", "Data", "DummyData", "Silence", "Accuracy")
+
+    # Static graph-output analysis.  A name is an output iff its FINAL
+    # production is never consumed downstream; per-event tracking keeps
+    # in-place layers (bottom == top, e.g. ReLU) from hiding their result.
+    entry = next(iter(input_names), None)
+    if entry is None:
+        for s in specs:
+            if s.type in skip_types[:3] and s.tops:
+                entry = s.tops[0]
+                break
+    entry = entry or "data"
+    last_producer: Dict[str, int] = {entry: -1}
+    consumed_events = set()
+    skipped_tops = set()
+    for idx, s in enumerate(specs):
+        # skip-type layers neither consume (Accuracy is pruned, so the
+        # tensor it eats is still a real output) nor materialize their
+        # tops (a Data layer's 'label' never exists at run time)
+        if s.type not in skip_types:
+            for b in s.bottoms:
+                if b in last_producer:
+                    consumed_events.add((b, last_producer[b]))
+        for t in (s.tops or (s.name,)):
+            last_producer[t] = idx
+            if s.type in skip_types:
+                skipped_tops.add(t)
+            else:
+                skipped_tops.discard(t)
+    output_names = [
+        name for name, idx in last_producer.items()
+        if (name, idx) not in consumed_events and idx >= 0
+        and name not in skipped_tops
+    ] or [entry]
+
+    class CaffeGraph(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            tensors: Dict[str, Any] = {entry: x}
+            layouts: Dict[str, str] = {
+                entry: "nhwc" if x.ndim == 4 else "flat"}
+
+            ctx = dict(nn=nn, jax=jax, jnp=jnp, L=L,
+                       PriorBoxParam=PriorBoxParam, prior_box=prior_box,
+                       DetectionOutputParam=DetectionOutputParam,
+                       detection_output=detection_output,
+                       map_axis=_map_axis, Priors=_Priors, train=train,
+                       input_shape=x.shape)
+
+            for s in specs:
+                if s.type in skip_types:
+                    continue
+                fn = registry.get(s.type)
+                if fn is None:
+                    raise NotImplementedError(
+                        f"no converter for Caffe layer type {s.type!r} "
+                        f"(layer {s.name!r}); pass custom={{...}}")
+                ins = [tensors[b] for b in s.bottoms]
+                in_layouts = [layouts.get(b, "flat") for b in s.bottoms]
+                outs, out_layout = fn(self, s, ins, in_layouts, ctx)
+                # only plain lists signal multi-output (tuples — including
+                # the _Priors marker — are single values)
+                if not isinstance(outs, list):
+                    outs = [outs]
+                tops = s.tops or (s.name,)
+                for t, o in zip(tops, list(outs) * max(1, len(tops))):
+                    tensors[t] = o
+                    layouts[t] = out_layout
+
+            finals = [tensors[t] for t in output_names]
+            return finals[0] if len(finals) == 1 else tuple(finals)
+
+    return CaffeGraph()
+
+
+# -- converter registry -------------------------------------------------------
+# Each converter: fn(module, spec, inputs, in_layouts, ctx)
+#                 → (output(s), out_layout)
+
+
+def _cparam(spec: _Spec, *names, default=None):
+    node: Any = spec.params
+    for nm in names:
+        if not isinstance(node, Mapping) or nm not in node:
+            return default
+        node = node[nm]
+    return node
+
+
+def _conv(module, spec, ins, louts, ctx):
+    nn = ctx["nn"]
+    p = spec.params.get("convolution_param", {})
+    kh = int(p.get("kernel_h", 0) or _aslist(p.get("kernel_size", 1))[0])
+    kw = int(p.get("kernel_w", 0) or _aslist(p.get("kernel_size", 1))[-1])
+    sh = int(p.get("stride_h", 0) or _aslist(p.get("stride", 1))[0])
+    sw = int(p.get("stride_w", 0) or _aslist(p.get("stride", 1))[-1])
+    ph = int(p.get("pad_h", 0) or _aslist(p.get("pad", 0))[0])
+    pw_ = int(p.get("pad_w", 0) or _aslist(p.get("pad", 0))[-1])
+    dil = int(_aslist(p.get("dilation", 1))[0])
+    x = _to_nhwc(ins[0], louts[0], ctx)
+    y = nn.Conv(int(p["num_output"]), (kh, kw), strides=(sh, sw),
+                padding=((ph, ph), (pw_, pw_)), kernel_dilation=(dil, dil),
+                feature_group_count=int(p.get("group", 1)),
+                use_bias=bool(p.get("bias_term", True)),
+                name=spec.name)(x)
+    return y, "nhwc"
+
+
+def _to_nhwc(x, layout, ctx):
+    if layout == "nchw" and x.ndim == 4:
+        return ctx["jnp"].transpose(x, (0, 2, 3, 1))
+    return x
+
+
+def _relu(module, spec, ins, louts, ctx):
+    slope = float(_cparam(spec, "relu_param", "negative_slope", default=0.0))
+    jnp = ctx["jnp"]
+    x = ins[0]
+    y = jnp.where(x > 0, x, slope * x) if slope else ctx["jax"].nn.relu(x)
+    return y, louts[0]
+
+
+def _pool(module, spec, ins, louts, ctx):
+    L = ctx["L"]
+    p = spec.params.get("pooling_param", {})
+    x = _to_nhwc(ins[0], louts[0], ctx)
+    if p.get("global_pooling"):
+        op = ctx["jnp"].max if p.get("pool", "MAX") == "MAX" else ctx["jnp"].mean
+        return op(x, axis=(1, 2), keepdims=True), "nhwc"
+    kh = int(p.get("kernel_h", 0) or p.get("kernel_size", 2))
+    kw = int(p.get("kernel_w", 0) or p.get("kernel_size", 2))
+    sh = int(p.get("stride_h", 0) or p.get("stride", 1))
+    sw = int(p.get("stride_w", 0) or p.get("stride", 1))
+    ph = int(p.get("pad_h", 0) or p.get("pad", 0))
+    pw_ = int(p.get("pad_w", 0) or p.get("pad", 0))
+    cls = (L.SpatialAveragePooling if p.get("pool") == "AVE"
+           else L.SpatialMaxPooling)
+    # caffe pooling is ceil-mode by default
+    return cls(kernel_size=(kh, kw), stride=(sh, sw), padding=(ph, pw_),
+               ceil_mode=True)(x), "nhwc"
+
+
+def _inner_product(module, spec, ins, louts, ctx):
+    nn, jnp = ctx["nn"], ctx["jnp"]
+    p = spec.params.get("inner_product_param", {})
+    x = ins[0]
+    if x.ndim > 2:
+        # caffe flattens C,H,W (logical NCHW order); make the physical
+        # flatten match so imported (out, C·H·W) weights line up
+        if louts[0] == "nhwc":
+            x = jnp.transpose(x, (0, 3, 1, 2))
+        x = x.reshape(x.shape[0], -1)
+    y = nn.Dense(int(p["num_output"]),
+                 use_bias=bool(p.get("bias_term", True)),
+                 name=spec.name)(x)
+    return y, "flat"
+
+
+def _lrn(module, spec, ins, louts, ctx):
+    jnp = ctx["jnp"]
+    p = spec.params.get("lrn_param", {})
+    size = int(p.get("local_size", 5))
+    alpha = float(p.get("alpha", 1.0))
+    beta = float(p.get("beta", 0.75))
+    k = float(p.get("k", 1.0))
+    x = _to_nhwc(ins[0], louts[0], ctx)
+    sq = x * x
+    half = size // 2
+    pads = [(0, 0)] * x.ndim
+    pads[-1] = (half, half)
+    padded = jnp.pad(sq, pads)
+    acc = sum(padded[..., i:i + x.shape[-1]] for i in range(size))
+    return x / (k + alpha / size * acc) ** beta, "nhwc"
+
+
+def _dropout(module, spec, ins, louts, ctx):
+    nn = ctx["nn"]
+    rate = float(_cparam(spec, "dropout_param", "dropout_ratio", default=0.5))
+    y = nn.Dropout(rate, deterministic=not ctx["train"])(ins[0])
+    return y, louts[0]
+
+
+def _softmax(module, spec, ins, louts, ctx):
+    axis = int(_cparam(spec, "softmax_param", "axis", default=1))
+    x = ins[0]
+    return ctx["jax"].nn.softmax(
+        x, axis=_map_axis(axis, louts[0], x.ndim)), louts[0]
+
+
+def _concat(module, spec, ins, louts, ctx):
+    if all(isinstance(i, _Priors) for i in ins):
+        jnp = ctx["jnp"]
+        pri = jnp.concatenate([i[0] for i in ins], axis=0)
+        var = jnp.concatenate([i[1] for i in ins], axis=0)
+        return _Priors((pri, var)), "priors"
+    axis = int(_cparam(spec, "concat_param", "axis", default=1))
+    x0 = ins[0]
+    return ctx["jnp"].concatenate(
+        list(ins), axis=_map_axis(axis, louts[0], x0.ndim)), louts[0]
+
+
+def _flatten(module, spec, ins, louts, ctx):
+    jnp = ctx["jnp"]
+    x = ins[0]
+    if x.ndim == 4 and louts[0] == "nhwc":
+        x = jnp.transpose(x, (0, 3, 1, 2))  # caffe flattens CHW order
+    return x.reshape(x.shape[0], -1), "flat"
+
+
+def _permute(module, spec, ins, louts, ctx):
+    jnp = ctx["jnp"]
+    order = tuple(int(v) for v in _aslist(
+        _cparam(spec, "permute_param", "order", default=[0, 1, 2, 3])))
+    x = ins[0]
+    if x.ndim == 4 and louts[0] == "nhwc":
+        if order == (0, 2, 3, 1):
+            # SSD head pattern: logical NCHW→NHWC — physically already there
+            return x, "nhwc_p"
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    return jnp.transpose(x, order), "nchw"
+
+
+def _reshape(module, spec, ins, louts, ctx):
+    jnp = ctx["jnp"]
+    shape_msg = _cparam(spec, "reshape_param", "shape", default={})
+    dims = [int(d) for d in _aslist(shape_msg.get("dim", []))]
+    x = ins[0]
+    if x.ndim == 4 and louts[0] == "nhwc":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    new = [x.shape[i] if d == 0 else d for i, d in enumerate(dims)]
+    return x.reshape(new), ("nchw" if len(new) == 4 else "flat")
+
+
+def _eltwise(module, spec, ins, louts, ctx):
+    jnp = ctx["jnp"]
+    op = _cparam(spec, "eltwise_param", "operation", default="SUM")
+    xs = [_to_nhwc(x, l, ctx) for x, l in zip(ins, louts)]
+    if op == "PROD":
+        out = xs[0]
+        for x in xs[1:]:
+            out = out * x
+    elif op == "MAX":
+        out = xs[0]
+        for x in xs[1:]:
+            out = jnp.maximum(out, x)
+    else:
+        coeffs = [float(c) for c in _aslist(
+            _cparam(spec, "eltwise_param", "coeff", default=[]))]
+        out = 0.0
+        for i, x in enumerate(xs):
+            out = out + (coeffs[i] if i < len(coeffs) else 1.0) * x
+    return out, "nhwc" if xs[0].ndim == 4 else louts[0]
+
+
+def _batch_norm(module, spec, ins, louts, ctx):
+    jnp = ctx["jnp"]
+    x = ins[0]
+    c = x.shape[-1] if louts[0] != "nchw" else x.shape[1]
+    eps = float(_cparam(spec, "batch_norm_param", "eps", default=1e-5))
+    mean = module.param(f"{spec.name}/moving_mean",
+                        ctx["nn"].initializers.zeros, (c,), jnp.float32)
+    var = module.param(f"{spec.name}/moving_var",
+                       ctx["nn"].initializers.ones, (c,), jnp.float32)
+    shape = [1] * x.ndim
+    shape[-1 if louts[0] != "nchw" else 1] = c
+    y = (x - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + eps)
+    return y, louts[0]
+
+
+def _scale(module, spec, ins, louts, ctx):
+    jnp = ctx["jnp"]
+    x = ins[0]
+    axis = -1 if louts[0] != "nchw" else 1
+    c = x.shape[axis]
+    scale = module.param(f"{spec.name}/scale",
+                         ctx["nn"].initializers.ones, (c,), jnp.float32)
+    shape = [1] * x.ndim
+    shape[axis] = c
+    y = x * scale.reshape(shape)
+    if _cparam(spec, "scale_param", "bias_term", default=False):
+        bias = module.param(f"{spec.name}/bias",
+                            ctx["nn"].initializers.zeros, (c,), jnp.float32)
+        y = y + bias.reshape(shape)
+    return y, louts[0]
+
+
+def _normalize(module, spec, ins, louts, ctx):
+    L = ctx["L"]
+    x = _to_nhwc(ins[0], louts[0], ctx)
+    init = float(_cparam(spec, "norm_param", "scale_filler", "value",
+                         default=20.0))
+    y = L.NormalizeScale(channels=x.shape[-1], scale=init,
+                         name=spec.name)(x)
+    return y, "nhwc"
+
+
+def _prior_box(module, spec, ins, louts, ctx):
+    p = spec.params.get("prior_box_param", {})
+    feat = ins[0]
+    img_h, img_w = ctx["input_shape"][1:3]
+    param = ctx["PriorBoxParam"](
+        min_sizes=[float(v) for v in _aslist(p.get("min_size", []))],
+        max_sizes=[float(v) for v in _aslist(p.get("max_size", []))],
+        aspect_ratios=[float(v) for v in _aslist(p.get("aspect_ratio", []))],
+        flip=bool(p.get("flip", True)),
+        clip=bool(p.get("clip", False)),
+        variances=tuple(float(v) for v in _aslist(
+            p.get("variance", [0.1, 0.1, 0.2, 0.2]))) or (0.1,) * 4,
+        step=float(p["step"]) if "step" in p else None,
+        offset=float(p.get("offset", 0.5)),
+    )
+    pri, var = ctx["prior_box"]((feat.shape[1], feat.shape[2]),
+                                (img_h, img_w), param)
+    jnp = ctx["jnp"]
+    return _Priors((jnp.asarray(pri), jnp.asarray(var))), "priors"
+
+
+def _detection_output(module, spec, ins, louts, ctx):
+    p = spec.params.get("detection_output_param", {})
+    n_classes = int(p.get("num_classes", 21))
+    loc, conf, priors = ins[0], ins[1], ins[2]
+    assert isinstance(priors, _Priors), (
+        "DetectionOutput expects a PriorBox(+Concat) bottom")
+    loc = loc.reshape(loc.shape[0], -1, 4)
+    conf = conf.reshape(conf.shape[0], -1, n_classes)
+    nmsp = p.get("nms_param", {})
+    param = ctx["DetectionOutputParam"](
+        n_classes=n_classes,
+        background_id=int(p.get("background_label_id", 0)),
+        conf_thresh=float(p.get("confidence_threshold", 0.01)),
+        nms_thresh=float(nmsp.get("nms_threshold", 0.45)),
+        nms_topk=int(nmsp.get("top_k", 400)),
+        keep_topk=int(p.get("keep_top_k", 200)),
+        share_location=bool(p.get("share_location", True)),
+    )
+    out = ctx["detection_output"](loc, conf, priors[0], priors[1], param)
+    return out, "flat"
+
+
+def _power(module, spec, ins, louts, ctx):
+    p = spec.params.get("power_param", {})
+    power = float(p.get("power", 1.0))
+    scale = float(p.get("scale", 1.0))
+    shift = float(p.get("shift", 0.0))
+    y = (shift + scale * ins[0])
+    if power != 1.0:
+        y = y ** power
+    return y, louts[0]
+
+
+def _unary(fn_name):
+    def conv(module, spec, ins, louts, ctx):
+        jnp, jax = ctx["jnp"], ctx["jax"]
+        fns = {"Sigmoid": jax.nn.sigmoid, "TanH": jnp.tanh,
+               "AbsVal": jnp.abs, "Exp": jnp.exp, "Log": jnp.log,
+               "BNLL": lambda x: jnp.log1p(jnp.exp(x))}
+        return fns[fn_name](ins[0]), louts[0]
+    return conv
+
+
+def _split(module, spec, ins, louts, ctx):
+    return [ins[0]] * max(1, len(spec.tops)), louts[0]
+
+
+def _slice(module, spec, ins, louts, ctx):
+    jnp = ctx["jnp"]
+    p = spec.params.get("slice_param", {})
+    axis = _map_axis(int(p.get("axis", 1)), louts[0], ins[0].ndim)
+    points = [int(v) for v in _aslist(p.get("slice_point", []))]
+    if points:
+        pieces = jnp.split(ins[0], points, axis=axis)
+    else:
+        pieces = jnp.split(ins[0], max(1, len(spec.tops)), axis=axis)
+    return list(pieces), louts[0]
+
+
+_CONVERTERS: Dict[str, Callable] = {
+    "Convolution": _conv,
+    "ReLU": _relu,
+    "Pooling": _pool,
+    "InnerProduct": _inner_product,
+    "LRN": _lrn,
+    "Dropout": _dropout,
+    "Softmax": _softmax,
+    "Concat": _concat,
+    "Flatten": _flatten,
+    "Permute": _permute,
+    "Reshape": _reshape,
+    "Eltwise": _eltwise,
+    "BatchNorm": _batch_norm,
+    "Scale": _scale,
+    "Normalize": _normalize,
+    "PriorBox": _prior_box,
+    "DetectionOutput": _detection_output,
+    "Power": _power,
+    "Sigmoid": _unary("Sigmoid"),
+    "TanH": _unary("TanH"),
+    "AbsVal": _unary("AbsVal"),
+    "Exp": _unary("Exp"),
+    "Log": _unary("Log"),
+    "BNLL": _unary("BNLL"),
+    "Split": _split,
+    "Slice": _slice,
+}

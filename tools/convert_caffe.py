@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Convert Caffe artifacts to this framework's checkpoint formats.
+
+Mirrors the reference's model-conversion tooling
+(``pipeline/ssd/data/models/convert_caffe_model.sh`` +
+``CaffeLoader.scala``): takes a ``.caffemodel`` (and optionally a deploy
+``.prototxt``) and produces either
+
+- a name-keyed ``.npz`` weight archive consumable by
+  ``utils.convert.load_weights_by_name`` / the SSD pipelines, or
+- a saved flax model built from the prototxt graph (``--build``).
+
+Examples:
+  python tools/convert_caffe.py model.caffemodel -o weights.npz
+  python tools/convert_caffe.py model.caffemodel --ssd 300 -o ssd_vgg.npz
+  python tools/convert_caffe.py model.caffemodel --prototxt deploy.prototxt \
+      --build --input-shape 1,300,300,3 -o model.msgpack
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("caffemodel", help=".caffemodel binary")
+    ap.add_argument("-o", "--output", required=True,
+                    help="output path (.npz, or .msgpack with --build)")
+    ap.add_argument("--prototxt", help="deploy prototxt (for --build)")
+    ap.add_argument("--ssd", type=int, choices=(300, 512), default=None,
+                    help="apply the SSD-VGG head rename for this resolution")
+    ap.add_argument("--build", action="store_true",
+                    help="build a flax model from --prototxt, load the "
+                         "weights into it, and save module variables")
+    ap.add_argument("--input-shape", default="1,300,300,3",
+                    help="NHWC example input for --build init")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from analytics_zoo_tpu.utils.caffe import (
+        build_caffe_graph, caffe_weight_dict, load_caffe_weights,
+        parse_prototxt, read_caffemodel, ssd_vgg_rename)
+
+    net = read_caffemodel(args.caffemodel)
+    weights = caffe_weight_dict(net)
+    print(f"read {args.caffemodel}: net={net.name!r}, "
+          f"{len(net.layers)} layers, {len(weights)} weight arrays")
+
+    if args.build:
+        if not args.prototxt:
+            ap.error("--build requires --prototxt")
+        import jax
+        import jax.numpy as jnp
+        from flax import serialization
+
+        netdef = parse_prototxt(args.prototxt)
+        module = build_caffe_graph(netdef)
+        shape = tuple(int(d) for d in args.input_shape.split(","))
+        variables = module.init(jax.random.PRNGKey(0),
+                                jnp.zeros(shape, jnp.float32))
+        params, report = load_caffe_weights(
+            variables["params"], args.caffemodel)
+        print(f"loaded {len(report['loaded'])} params, "
+              f"missing {len(report['missing'])}, "
+              f"unused {len(report['unused'])}")
+        with open(args.output, "wb") as f:
+            f.write(serialization.to_bytes({"params": params}))
+        print(f"wrote {args.output}")
+        return 0
+
+    rename = ssd_vgg_rename(args.ssd) if args.ssd else None
+    if rename:
+        weights = {rename(k): v for k, v in weights.items()}
+    np.savez(args.output, **{k: np.asarray(v) for k, v in weights.items()})
+    print(f"wrote {args.output} ({len(weights)} arrays)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
