@@ -17,6 +17,11 @@ from analytics_zoo_tpu.models.ssd_variants import (
     multibox_heads,
 )
 from analytics_zoo_tpu.models.deepspeech2 import DeepSpeech2, SequenceBN
-from analytics_zoo_tpu.models.simple import FraudMLP, NeuralCF, SentimentNet
+from analytics_zoo_tpu.models.simple import (
+    FraudMLP,
+    NeuralCF,
+    SentimentNet,
+    WideAndDeep,
+)
 
 __all__ = [k for k in dir() if not k.startswith("_")]

@@ -1,4 +1,4 @@
-"""Small model families: fraud MLP, sentiment heads, neural CF recommender.
+"""Small model families: fraud MLP, sentiment heads, recommenders.
 
 Ports of the reference's app models:
 - fraud MLP  — ``fraudDetection/src/BigDLKaggleFraud.scala:37-39``:
@@ -7,6 +7,9 @@ Ports of the reference's app models:
   + selectable GRU / LSTM / BiLSTM / CNN / CNN-LSTM head → binary sigmoid.
 - NCF        — ``apps/recommendation/recommender-explicit-feedback.ipynb``:
   user/item LookupTables → concat → MLP → LogSoftMax over 5 rating classes.
+- Wide&Deep  — the recommendation family's second architecture
+  (BASELINE.json configs "Neural CF / Wide&Deep"): a linear wide path over
+  hashed cross features joint-trained with a deep embedding MLP.
 """
 
 from __future__ import annotations
@@ -76,6 +79,53 @@ class SentimentNet(nn.Module):
         h = nn.Dropout(0.2, deterministic=not train)(h)
         h = nn.Dense(1, name="fc")(h)
         return jax.nn.sigmoid(h)[..., 0]
+
+
+class WideAndDeep(nn.Module):
+    """Wide & Deep recommender: ``(user_ids, item_ids)`` → ``(B, n_classes)``
+    log-probs.
+
+    The wide path is the classic linear-in-one-hot model — per-id linear
+    terms plus a hashed user×item cross-product bucket, each expressed as
+    an ``n_classes``-wide embedding lookup (a lookup IS the one-hot matmul,
+    and it keeps the whole model a single XLA program: no sparse ops).
+    The deep path matches NeuralCF's embedding MLP.  Joint training sums
+    the two logit paths before the softmax, per the Wide&Deep paper.
+    """
+
+    n_users: int = 1000
+    n_items: int = 1000
+    embedding_dim: int = 20
+    hidden: Sequence[int] = (40, 20)
+    n_classes: int = 5
+    cross_buckets: int = 1000
+
+    @nn.compact
+    def __call__(self, users, items):
+        users = users.astype(jnp.int32)
+        items = items.astype(jnp.int32)
+        zeros = nn.initializers.zeros
+        # wide: w_user[u] + w_item[i] + w_cross[hash(u, i)] + b
+        # (multiplicative hash in wrapping uint32, then bucket)
+        cross = ((users.astype(jnp.uint32) * jnp.uint32(2654435761)
+                  + items.astype(jnp.uint32))
+                 % jnp.uint32(self.cross_buckets)).astype(jnp.int32)
+        wide = (
+            nn.Embed(self.n_users, self.n_classes, name="wide_user",
+                     embedding_init=zeros)(users)
+            + nn.Embed(self.n_items, self.n_classes, name="wide_item",
+                       embedding_init=zeros)(items)
+            + nn.Embed(self.cross_buckets, self.n_classes, name="wide_cross",
+                       embedding_init=zeros)(cross)
+        )
+        # deep: embedding concat → MLP
+        u = nn.Embed(self.n_users, self.embedding_dim, name="user_embed")(users)
+        v = nn.Embed(self.n_items, self.embedding_dim, name="item_embed")(items)
+        h = jnp.concatenate([u, v], axis=-1)
+        for i, width in enumerate(self.hidden):
+            h = nn.relu(nn.Dense(width, name=f"fc{i}")(h))
+        deep = nn.Dense(self.n_classes, name="out")(h)
+        return jax.nn.log_softmax(wide + deep, axis=-1)
 
 
 class NeuralCF(nn.Module):

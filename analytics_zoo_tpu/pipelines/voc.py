@@ -126,6 +126,13 @@ def get_imdb(name: str, root: str):
     parts = name.split("_")
     if parts[0] == "voc":
         return PascalVoc(root, year=parts[1], image_set="_".join(parts[2:]))
+    if parts[0] == "coco":
+        # standard COCO layout: <root>/<set>/ images,
+        # <root>/annotations/instances_<set>.json
+        subset = "_".join(parts[1:])
+        return Coco(os.path.join(root, subset),
+                    os.path.join(root, "annotations",
+                                 f"instances_{subset}.json"))
     raise ValueError(f"unknown imdb {name!r}")
 
 

@@ -1,7 +1,8 @@
-"""Neural CF recommender (reference ``apps/recommendation/
-recommender-explicit-feedback.ipynb``): user/item embeddings → MLP →
-LogSoftMax over 5 rating classes; ClassNLL + Adam; MAE/Loss validation;
-top-K recommendation by predicted class."""
+"""Recommender (reference ``apps/recommendation/
+recommender-explicit-feedback.ipynb``): selectable Neural CF or Wide&Deep
+model (BASELINE.json configs "Neural CF / Wide&Deep") over 5 rating
+classes; ClassNLL + Adam; MAE/Loss validation; top-K recommendation by
+predicted class."""
 
 import argparse
 import logging
@@ -19,6 +20,8 @@ def main():
     p.add_argument("--epochs", type=int, default=8)
     p.add_argument("--batch-size", type=int, default=256)
     p.add_argument("--topk", type=int, default=5)
+    p.add_argument("--model", choices=("ncf", "wide_and_deep"),
+                   default="ncf")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -27,7 +30,7 @@ def main():
 
     from analytics_zoo_tpu.core.criterion import ClassNLLCriterion
     from analytics_zoo_tpu.core.module import Model
-    from analytics_zoo_tpu.models import NeuralCF
+    from analytics_zoo_tpu.models import NeuralCF, WideAndDeep
     from analytics_zoo_tpu.parallel import (MAE, Adam, Loss, Optimizer,
                                             Trigger, create_mesh)
 
@@ -60,7 +63,8 @@ def main():
                            "target": stars[sel]}
         return _DS()
 
-    model = Model(NeuralCF(n_users=args.users, n_items=args.items))
+    net_cls = WideAndDeep if args.model == "wide_and_deep" else NeuralCF
+    model = Model(net_cls(n_users=args.users, n_items=args.items))
     model.build(0, jnp.zeros(2, jnp.int32), jnp.zeros(2, jnp.int32))
     crit = ClassNLLCriterion()
     (Optimizer(model, batches(0, split, True), crit, mesh=create_mesh())

@@ -1,0 +1,100 @@
+"""Train DeepSpeech2 with CTC — net-new capability (the reference's DS2 is
+inference-only, ``deepspeech2/example/*``; SURVEY.md §2.3).
+
+Without ``--data-dir``, trains on a synthetic tone→label task: each class
+is a pure tone; the featurization chain (``transform/audio/featurize``)
+turns it into mel frames and the model learns to emit the class token —
+a self-contained end-to-end check of the CTC training path.
+
+With ``--data-dir``, expects ``<dir>/mapping.txt`` lines ``<wav-path>
+<TRANSCRIPT>`` (LibriSpeech-style, reference ``InferenceEvaluate``
+``loadData``) and trains on those utterances.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def synthetic_batches(n_batches, batch_size, utt_length=100, n_mels=13,
+                      n_tokens=4, seed=0):
+    """Tone-like synthetic features with per-frame class structure."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    batches = []
+    for _ in range(n_batches):
+        labels = rng.randint(1, n_tokens, size=(batch_size, 2)).astype(np.int32)
+        x = rng.randn(batch_size, utt_length, n_mels).astype(np.float32) * 0.1
+        # paint each label's signature into a half of the time axis
+        half = utt_length // 2
+        for b in range(batch_size):
+            for k in range(2):
+                sl = slice(k * half, (k + 1) * half)
+                x[b, sl, labels[b, k] % n_mels] += 2.0
+        batches.append({
+            "input": x,
+            "labels": labels,
+            "label_mask": np.ones_like(labels, np.float32),
+        })
+    return batches
+
+
+def main():
+    p = argparse.ArgumentParser(description="Train DeepSpeech2 (CTC)")
+    p.add_argument("--data-dir", default=None,
+                   help="dir with mapping.txt + audio; synthetic if unset")
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--rnn-layers", type=int, default=1)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--checkpoint", default=None)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import numpy as np
+
+    from analytics_zoo_tpu.pipelines.deepspeech2 import make_ds2_model, train_ds2
+    from analytics_zoo_tpu.transform.audio import (
+        ALPHABET, TranscriptVectorizer, featurize, read_audio)
+
+    if args.data_dir:
+        # TranscriptVectorizer yields padded (ids, mask) pairs already
+        vec = TranscriptVectorizer(ALPHABET)
+        feats, ids_rows, mask_rows = [], [], []
+        with open(os.path.join(args.data_dir, "mapping.txt")) as f:
+            for line in f:
+                path, _, text = line.strip().partition(" ")
+                samples, _ = read_audio(os.path.join(args.data_dir, path))
+                feats.append(featurize(samples, utt_length=1000))
+                ids, mask = vec(text)
+                ids_rows.append(ids)
+                mask_rows.append(mask)
+        x = np.stack(feats)
+        lab = np.stack(ids_rows)
+        mask = np.stack(mask_rows)
+        batches = [
+            {"input": x[i:i + args.batch_size],
+             "labels": lab[i:i + args.batch_size],
+             "label_mask": mask[i:i + args.batch_size]}
+            for i in range(0, len(x) - args.batch_size + 1, args.batch_size)
+        ]
+        utt_length = x.shape[1]
+    else:
+        utt_length = 100
+        batches = synthetic_batches(8, args.batch_size,
+                                    utt_length=utt_length, n_tokens=4)
+
+    model = make_ds2_model(hidden=args.hidden, n_rnn_layers=args.rnn_layers,
+                           utt_length=utt_length)
+    train_ds2(model, batches, epochs=args.epochs, lr=args.lr,
+              checkpoint_path=args.checkpoint)
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

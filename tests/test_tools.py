@@ -1,0 +1,124 @@
+"""Dataset tooling tests: SequenceFile round-trip + one-command VOC→.azr.
+
+Covers the reference-format interchange (``RoiByteImageToSeq.scala:33``
+record layout inside Hadoop SequenceFiles) and the get_pascal ingest path
+(``pipeline/ssd/data/pascal/*.sh`` equivalents).
+"""
+
+import os
+import sys
+import textwrap
+
+import cv2
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from analytics_zoo_tpu.data.records import (
+    SSDByteRecord,
+    read_ssd_records,
+    write_ssd_records,
+)
+from tools.seqfile_to_azr import (
+    decode_reference_record,
+    encode_reference_record,
+    read_sequence_file,
+    read_vint,
+    write_sequence_file,
+    write_vint,
+)
+from tools import get_pascal, seqfile_to_azr
+
+
+def _jpeg(seed=0, w=32, h=24):
+    rng = np.random.RandomState(seed)
+    ok, buf = cv2.imencode(".jpg", (rng.rand(h, w, 3) * 255).astype(np.uint8))
+    assert ok
+    return buf.tobytes()
+
+
+class TestVint:
+    def test_roundtrip(self):
+        for v in (0, 1, 127, -112, 128, 300, 65535, -129, 2 ** 30, -2 ** 30):
+            buf = write_vint(v)
+            out, off = read_vint(buf, 0)
+            assert out == v, v
+            assert off == len(buf)
+
+
+class TestSequenceFileRoundTrip:
+    def test_records_roundtrip_with_sync(self, tmp_path):
+        recs = [
+            SSDByteRecord(
+                data=_jpeg(i), path=f"img{i}.jpg",
+                gt=np.asarray([[1 + i % 3, 0, 4, 5, 20, 18],
+                               [2, 1, 1, 2, 10, 12]], np.float32))
+            for i in range(12)
+        ]
+        recs.append(SSDByteRecord(data=_jpeg(99), path="empty.jpg",
+                                  gt=np.zeros((0, 6), np.float32)))
+        seq = str(tmp_path / "part-0.seq")
+        write_sequence_file(seq, [encode_reference_record(r) for r in recs],
+                            sync_interval=4)  # force sync-escape records
+        back = [decode_reference_record(k, v)
+                for k, v in read_sequence_file(seq)]
+        assert len(back) == len(recs)
+        for a, b in zip(recs, back):
+            assert b.data == a.data
+            assert b.path == os.path.basename(a.path)
+            np.testing.assert_allclose(b.gt, a.gt)
+
+    def test_cli_converts_to_azr(self, tmp_path):
+        recs = [SSDByteRecord(data=_jpeg(i), path=f"i{i}.jpg",
+                              gt=np.asarray([[1, 0, 1, 2, 9, 9]], np.float32))
+                for i in range(5)]
+        seq = str(tmp_path / "data.seq")
+        write_sequence_file(seq, [encode_reference_record(r) for r in recs])
+        out_prefix = str(tmp_path / "out")
+        assert seqfile_to_azr.main([seq, "-o", out_prefix, "-p", "2"]) == 0
+        shards = sorted(str(p) for p in tmp_path.glob("out-*.azr"))
+        assert len(shards) == 2
+        back = list(read_ssd_records(shards))
+        assert len(back) == 5
+        assert {b.data for b in back} == {r.data for r in recs}
+
+
+def _mini_devkit(root, n=4):
+    """Synthesize a tiny VOCdevkit 2007 with JPEGs + XML annotations."""
+    base = os.path.join(root, "VOC2007")
+    for sub in ("Annotations", "JPEGImages", "ImageSets/Main"):
+        os.makedirs(os.path.join(base, sub), exist_ok=True)
+    ids = []
+    for i in range(n):
+        img_id = f"{i:06d}"
+        ids.append(img_id)
+        with open(os.path.join(base, "JPEGImages", img_id + ".jpg"), "wb") as f:
+            f.write(_jpeg(i, w=48, h=36))
+        xml = textwrap.dedent(f"""\
+            <annotation>
+              <size><width>48</width><height>36</height><depth>3</depth></size>
+              <object><name>dog</name><difficult>0</difficult>
+                <bndbox><xmin>{4 + i}</xmin><ymin>5</ymin>
+                        <xmax>{20 + i}</xmax><ymax>30</ymax></bndbox>
+              </object>
+            </annotation>""")
+        with open(os.path.join(base, "Annotations", img_id + ".xml"), "w") as f:
+            f.write(xml)
+    with open(os.path.join(base, "ImageSets", "Main", "trainval.txt"), "w") as f:
+        f.write("\n".join(ids) + "\n")
+
+
+class TestGetPascal:
+    def test_devkit_to_shards(self, tmp_path):
+        devkit = str(tmp_path / "VOCdevkit")
+        _mini_devkit(devkit)
+        out = str(tmp_path / "azr" / "voc")
+        rc = get_pascal.main(["--devkit", devkit, "-o", out,
+                              "--sets", "voc_2007_trainval", "-p", "2"])
+        assert rc == 0
+        shards = sorted((tmp_path / "azr").glob("*.azr"))
+        assert len(shards) == 2
+        back = list(read_ssd_records([str(s) for s in shards]))
+        assert len(back) == 4
+        assert all(b.gt.shape == (1, 6) for b in back)
+        assert all(b.gt[0, 0] == 12.0 for b in back)  # dog class id

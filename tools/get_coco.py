@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""COCO → training-ready .azr shards, one command.
+
+Mirrors the reference's COCO tooling (``pipeline/ssd/data/coco/
+get_coco.sh`` + ``create_list.py`` + ``convert_coco.sh``): optionally
+download the image/annotation zips, extract, and convert the instances
+annotations into sharded record files (80-class contiguous remap is done
+by ``pipelines.voc.Coco``).
+
+Example:
+  python tools/get_coco.py --root /data/coco --sets val2017 -o /data/azr/coco
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import zipfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ZIPS = {
+    "train2017.zip": "http://images.cocodataset.org/zips/train2017.zip",
+    "val2017.zip": "http://images.cocodataset.org/zips/val2017.zip",
+    "annotations_trainval2017.zip":
+        "http://images.cocodataset.org/annotations/annotations_trainval2017.zip",
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--root", required=True,
+                    help="COCO root: <root>/<set>/ images + "
+                         "<root>/annotations/instances_<set>.json")
+    ap.add_argument("--zip-dir", help="directory holding the COCO zips")
+    ap.add_argument("--download", action="store_true",
+                    help="fetch zips from images.cocodataset.org first")
+    ap.add_argument("--sets", default="val2017",
+                    help="comma-separated subsets (e.g. train2017,val2017)")
+    ap.add_argument("-o", "--output", required=True, help="output prefix")
+    ap.add_argument("-p", "--num-shards", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    subsets = [s.strip() for s in args.sets.split(",")]
+    if args.download and not args.zip_dir:
+        raise SystemExit("--download requires --zip-dir")
+    if args.zip_dir:
+        os.makedirs(args.zip_dir, exist_ok=True)
+        wanted = [n for n in ZIPS
+                  if n.startswith("annotations")
+                  or any(n.startswith(s) for s in subsets)]
+        if args.download:
+            import urllib.request
+
+            for name in wanted:
+                dst = os.path.join(args.zip_dir, name)
+                if not os.path.exists(dst):
+                    print(f"downloading {ZIPS[name]} …")
+                    urllib.request.urlretrieve(ZIPS[name], dst)
+        for name in sorted(os.listdir(args.zip_dir)):
+            if not name.endswith(".zip") or name not in wanted:
+                continue
+            # skip zips whose content is already on disk
+            done_marker = (os.path.join(args.root, "annotations")
+                           if name.startswith("annotations")
+                           else os.path.join(args.root, name[:-4]))
+            if os.path.isdir(done_marker):
+                continue
+            path = os.path.join(args.zip_dir, name)
+            print(f"extracting {path} …")
+            with zipfile.ZipFile(path) as z:
+                z.extractall(args.root)
+
+    from analytics_zoo_tpu.data.records import write_ssd_records
+    from analytics_zoo_tpu.pipelines.voc import get_imdb
+
+    for subset in subsets:
+        records = list(get_imdb(f"coco_{subset}", args.root).load())
+        if not records:
+            print(f"WARNING: coco_{subset}: nothing under {args.root}")
+            continue
+        paths = write_ssd_records(records, f"{args.output}-{subset}",
+                                  args.num_shards)
+        print(f"coco_{subset}: {len(records)} records → {len(paths)} shards "
+              f"({paths[0]} …)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Pascal VOC → training-ready .azr shards, one command.
+
+Mirrors the reference's dataset scripts
+(``pipeline/ssd/data/pascal/get_pascal.sh`` + ``convert_pascal.sh``):
+optionally download the VOC tarballs, extract, and convert the standard
+image sets into sharded record files consumable by
+``pipelines.ssd.load_train_set``.
+
+Examples:
+  # already-extracted devkit → shards
+  python tools/get_pascal.py --devkit /data/VOCdevkit -o /data/azr/voc
+
+  # tarballs present (or --download on a connected machine)
+  python tools/get_pascal.py --tar-dir /data/tars -o /data/azr/voc
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tarfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# upstream tarball names (reference get_pascal.sh)
+TARS = {
+    "VOCtrainval_06-Nov-2007.tar":
+        "http://host.robots.ox.ac.uk/pascal/VOC/voc2007/VOCtrainval_06-Nov-2007.tar",
+    "VOCtest_06-Nov-2007.tar":
+        "http://host.robots.ox.ac.uk/pascal/VOC/voc2007/VOCtest_06-Nov-2007.tar",
+    "VOCtrainval_11-May-2012.tar":
+        "http://host.robots.ox.ac.uk/pascal/VOC/voc2012/VOCtrainval_11-May-2012.tar",
+}
+
+DEFAULT_SETS = ("voc_2007_trainval", "voc_2007_test")
+
+
+def ensure_devkit(args) -> str:
+    if args.devkit:
+        return args.devkit
+    if not args.tar_dir:
+        raise SystemExit("need --devkit (extracted) or --tar-dir")
+    os.makedirs(args.tar_dir, exist_ok=True)
+    if args.download:
+        import urllib.request
+
+        for name, url in TARS.items():
+            dst = os.path.join(args.tar_dir, name)
+            if os.path.exists(dst):
+                continue
+            print(f"downloading {url} …")
+            urllib.request.urlretrieve(url, dst)
+    extract_root = args.extract_dir or args.tar_dir
+    for name in os.listdir(args.tar_dir):
+        if not name.endswith(".tar"):
+            continue
+        path = os.path.join(args.tar_dir, name)
+        print(f"extracting {path} …")
+        with tarfile.open(path) as t:
+            t.extractall(extract_root, filter="data")
+    devkit = os.path.join(extract_root, "VOCdevkit")
+    if not os.path.isdir(devkit):
+        raise SystemExit(f"no VOCdevkit under {extract_root} after extract")
+    return devkit
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--devkit", help="existing extracted VOCdevkit root")
+    ap.add_argument("--tar-dir", help="directory holding the VOC tarballs")
+    ap.add_argument("--extract-dir", help="where to extract (default tar-dir)")
+    ap.add_argument("--download", action="store_true",
+                    help="fetch tarballs from the upstream VOC server first")
+    ap.add_argument("-o", "--output", required=True,
+                    help="output prefix; per-set shards get a -<set> suffix")
+    ap.add_argument("--sets", default=",".join(DEFAULT_SETS),
+                    help="comma-separated imagesets (voc_<year>_<split>)")
+    ap.add_argument("-p", "--num-shards", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    from analytics_zoo_tpu.data.records import write_ssd_records
+    from analytics_zoo_tpu.pipelines.voc import get_imdb
+
+    devkit = ensure_devkit(args)
+    for name in args.sets.split(","):
+        name = name.strip()
+        records = list(get_imdb(name, devkit).load())
+        if not records:
+            print(f"WARNING: {name}: no records found under {devkit}")
+            continue
+        paths = write_ssd_records(records, f"{args.output}-{name}",
+                                  args.num_shards)
+        print(f"{name}: {len(records)} records → {len(paths)} shards "
+              f"({paths[0]} …)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
