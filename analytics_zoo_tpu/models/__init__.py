@@ -16,7 +16,17 @@ from analytics_zoo_tpu.models.ssd_variants import (
     mobilenet_ssd_config,
     multibox_heads,
 )
-from analytics_zoo_tpu.models.deepspeech2 import DeepSpeech2, SequenceBN
+from analytics_zoo_tpu.models.deepspeech2 import (
+    DeepSpeech2,
+    SequenceBN,
+    sequence_parallel_forward,
+)
+from analytics_zoo_tpu.models.attention import (
+    AttentionASR,
+    LongContextEncoder,
+    MultiHeadSelfAttention,
+    TransformerBlock,
+)
 from analytics_zoo_tpu.models.simple import (
     FraudMLP,
     NeuralCF,

@@ -1,0 +1,125 @@
+"""Long-context attention models — the ring-attention consumers.
+
+Net-new capability class vs the reference (its 2017 zoo has no attention;
+SURVEY.md §5 "Long-context: none"): a transformer encoder whose attention
+op is *pluggable*, so the same model runs
+
+- single-device with :func:`parallel.sequence.full_attention`, or
+- sequence-parallel with :func:`parallel.sequence.ring_attention` — the
+  time axis sharded over the mesh's ``sequence`` axis, K/V blocks rotating
+  over ICI while every other stage (projections, LayerNorm, MLP) is
+  pointwise over T and partitions for free under jit.
+
+``AttentionASR`` is the modernized DS2: the same stride-2 conv front-end
+and CTC head as ``models.deepspeech2``, with the BiRNN stack replaced by
+transformer blocks — long utterances stream through sequence-sharded
+instead of lossy-chunked (reference ``TimeSegmenter.scala:11``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.parallel.sequence import full_attention
+
+
+class MultiHeadSelfAttention(nn.Module):
+    """QKV projection around a pluggable ``attention_fn(q, k, v)`` that
+    takes/returns (B, T, H, D_head)."""
+
+    dim: int
+    num_heads: int = 4
+    attention_fn: Callable = full_attention
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, _ = x.shape
+        head_dim = self.dim // self.num_heads
+        qkv = nn.Dense(3 * self.dim, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (B, T, self.num_heads, head_dim)
+        out = self.attention_fn(q.reshape(shape), k.reshape(shape),
+                                v.reshape(shape))
+        return nn.Dense(self.dim, name="proj")(out.reshape(B, T, self.dim))
+
+
+class TransformerBlock(nn.Module):
+    dim: int
+    num_heads: int = 4
+    mlp_ratio: int = 4
+    attention_fn: Callable = full_attention
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(name="ln1")(x)
+        x = x + MultiHeadSelfAttention(
+            dim=self.dim, num_heads=self.num_heads,
+            attention_fn=self.attention_fn, name="attn")(h)
+        h = nn.LayerNorm(name="ln2")(x)
+        h = nn.Dense(self.dim * self.mlp_ratio, name="mlp1")(h)
+        h = nn.gelu(h)
+        return x + nn.Dense(self.dim, name="mlp2")(h)
+
+
+class LongContextEncoder(nn.Module):
+    """(B, T, F) → (B, T, dim) transformer encoder with sinusoidal
+    positions; attention_fn selects full vs ring (sequence-parallel)."""
+
+    dim: int = 128
+    depth: int = 4
+    num_heads: int = 4
+    attention_fn: Callable = full_attention
+
+    @nn.compact
+    def __call__(self, x):
+        T = x.shape[1]
+        h = nn.Dense(self.dim, name="embed")(x)
+        h = h + jnp.asarray(_sinusoid(T, self.dim), h.dtype)
+        for i in range(self.depth):
+            h = TransformerBlock(dim=self.dim, num_heads=self.num_heads,
+                                 attention_fn=self.attention_fn,
+                                 name=f"block{i}")(h)
+        return nn.LayerNorm(name="ln_out")(h)
+
+
+def _sinusoid(T: int, dim: int) -> np.ndarray:
+    pos = np.arange(T)[:, None]
+    div = np.exp(np.arange(0, dim, 2) * (-np.log(10000.0) / dim))
+    pe = np.zeros((T, dim), np.float32)
+    pe[:, 0::2] = np.sin(pos * div)
+    pe[:, 1::2] = np.cos(pos * div)
+    return pe
+
+
+class AttentionASR(nn.Module):
+    """DS2-with-attention: conv front-end (stride-2 time) → transformer
+    encoder → CTC log-probs (B, T/2, n_alphabet).  Same featurization and
+    decoders as ``models.deepspeech2``; swap ``attention_fn`` for
+    ``RingAttentionLayer(mesh)`` to run sequence-parallel."""
+
+    dim: int = 128
+    depth: int = 4
+    num_heads: int = 4
+    n_alphabet: int = 29
+    n_mels: int = 13
+    conv_channels: int = 32
+    attention_fn: Callable = full_attention
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B, T, F = x.shape
+        h = x[..., None]
+        h = nn.Conv(self.conv_channels, (11, self.n_mels), strides=(2, 1),
+                    padding=((5, 5), (0, 0)), name="conv1")(h)
+        h = jnp.clip(h.reshape(B, h.shape[1], -1), 0.0, 20.0)
+        h = LongContextEncoder(dim=self.dim, depth=self.depth,
+                               num_heads=self.num_heads,
+                               attention_fn=self.attention_fn,
+                               name="encoder")(h)
+        logits = nn.Dense(self.n_alphabet, name="fc_out")(h)
+        return jax.nn.log_softmax(logits, axis=-1)

@@ -85,3 +85,94 @@ class DeepSpeech2(nn.Module):
         h = SequenceBN(name="bn_out")(h, train=train)
         logits = nn.Dense(self.n_alphabet, name="fc_out")(h)
         return jax.nn.log_softmax(logits, axis=-1)
+
+
+def sequence_parallel_forward(variables, x, mesh,
+                              axis_name: str = "sequence",
+                              batch_axis: str = None,
+                              model: "DeepSpeech2" = None):
+    """DS2 inference forward with the TIME axis sharded across devices —
+    the SURVEY.md §5 north-star capability ("shard T across devices for
+    DS2 BiRNN"); the reference's only long-audio mechanism is lossy
+    chunking with zeroed boundary state (``TimeSegmenter.scala:11``).
+
+    ``x``: (B, T, n_mels), T divisible by 2·mesh["sequence"].  Exactness:
+    - the stride-2 conv front-end runs VALID on halo-extended chunks
+      (``parallel.sequence.halo_exchange``; edge devices' zero halos equal
+      the global zero padding),
+    - pointwise stages (projection matmuls, inference BN, output head) act
+      per-frame and need no communication,
+    - each BiRNN layer is an exact pipelined chunk scan with boundary
+      states hopping over ICI: both directions are fused into ONE round
+      loop (``sequence_scan_local_bidir``), so a layer costs n rounds.
+    Output matches ``model.apply`` on unsharded input to float tolerance
+    (rtol 1e-4 — BN/matmul reassociation differs; asserted by
+    tests/test_sequence_rnn.py).
+
+    Memory per device is O(T/n), so utterances far beyond single-chip HBM
+    stream through; wall-clock of the recurrence itself stays sequential
+    (inherent to RNNs — attention models get ring_attention instead).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from analytics_zoo_tpu.parallel.sequence import (
+        _shard_map, halo_exchange, sequence_scan_local_bidir)
+
+    model = model or DeepSpeech2()
+    params = variables["params"]
+    stats = variables.get("batch_stats", {})
+    eps = 1e-5
+
+    def bn(name, h):
+        p, s = params[name]["BatchNorm_0"], stats[name]["BatchNorm_0"]
+        inv = p["scale"] / jnp.sqrt(s["var"] + eps)
+        return (h - s["mean"]) * inv + p["bias"]
+
+    def rnn_step(kernel, bias):
+        def step(h, x_t):
+            y = jnp.clip(x_t + h @ kernel + bias, 0.0, 20.0)
+            return y, y
+        return step
+
+    n_seq = mesh.shape[axis_name]
+    if x.shape[1] % (2 * n_seq):
+        raise ValueError(
+            f"T={x.shape[1]} must be divisible by 2·n_seq={2 * n_seq} "
+            "(even per-device chunks for the stride-2 conv front-end)")
+
+    def local(x_l):
+        B, Tb, F = x_l.shape
+        h = x_l[..., None]
+        # conv1: kernel 11 pad 5 stride 2 → halo 5 each side, VALID conv
+        ext = halo_exchange(h, axis_name, 5, 5, time_axis=1)
+        h = jax.lax.conv_general_dilated(
+            ext, params["conv1"]["kernel"], window_strides=(2, 1),
+            padding=((0, 0), (0, 0)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + params["conv1"]["bias"]
+        h = h.reshape(B, h.shape[1], -1)
+        h = jnp.clip(bn("bn_conv1", h), 0.0, 20.0)
+        for i in range(model.n_rnn_layers):
+            h = h @ params[f"proj{i}"]["kernel"] + params[f"proj{i}"]["bias"]
+            h = bn(f"bn_rnn{i}", h)
+            h0 = jnp.zeros((B, model.hidden), h.dtype)
+            bi = params[f"birnn{i}"]
+            fwd, bwd = sequence_scan_local_bidir(
+                rnn_step(bi["fwd"]["body"]["h2h"]["kernel"],
+                         bi["fwd"]["body"]["h2h"]["bias"]),
+                rnn_step(bi["bwd"]["body"]["h2h"]["kernel"],
+                         bi["bwd"]["body"]["h2h"]["bias"]),
+                h0, h, axis_name)
+            h = fwd + bwd
+        h = bn("bn_out", h)
+        logits = h @ params["fc_out"]["kernel"] + params["fc_out"]["bias"]
+        return jax.nn.log_softmax(logits, axis=-1)
+
+    spec = P(batch_axis, axis_name, None)
+    fn = _shard_map(local, mesh, in_specs=(spec,), out_specs=spec)
+    sharding = NamedSharding(mesh, spec)
+    if isinstance(x, jax.core.Tracer):   # under jit: constrain, don't put
+        x = jax.lax.with_sharding_constraint(x, sharding)
+    else:
+        x = jax.device_put(x, sharding)
+    return fn(x)

@@ -55,14 +55,43 @@ class DS2Param:
 
 class DeepSpeech2Pipeline:
     """fit-less inference pipeline (the reference's Spark ML Pipeline of 6
-    stages collapses into segment → featurize → forward → decode)."""
+    stages collapses into segment → featurize → forward → decode).
 
-    def __init__(self, model: Model, param: DS2Param = DS2Param()):
+    ``sequence_mesh``: a Mesh with a ``sequence`` axis switches the forward
+    to the time-sharded ``models.deepspeech2.sequence_parallel_forward`` —
+    utterances longer than one chip's HBM stream through exactly, instead
+    of relying on the lossy TimeSegmenter chunking alone.
+    """
+
+    def __init__(self, model: Model, param: DS2Param = DS2Param(),
+                 sequence_mesh=None):
         self.model = model
         self.param = param
         self.segmenter = TimeSegmenter(
             segment_size=SAMPLE_RATE * param.segment_seconds)
-        self._eval_step = make_eval_step(model.module)
+        self.utt_length = param.utt_length
+        if sequence_mesh is not None:
+            import jax
+
+            from analytics_zoo_tpu.models.deepspeech2 import (
+                sequence_parallel_forward)
+
+            # chunks must be even per device (stride-2 conv front-end)
+            mult = 2 * sequence_mesh.shape["sequence"]
+            self.utt_length = ((self.utt_length + mult - 1) // mult) * mult
+            batch_axis = ("data" if "data" in sequence_mesh.axis_names
+                          else None)
+            # data-axis sharding needs B divisible by the axis: pad ragged
+            # final chunks up to batch_size (trimmed again after forward)
+            self._pad_to_batch = batch_axis is not None
+            # jit once: re-invocations hit the compile cache per batch shape
+            self._eval_step = jax.jit(
+                lambda variables, x: sequence_parallel_forward(
+                    variables, x, sequence_mesh, batch_axis=batch_axis,
+                    model=model.module))
+        else:
+            self._eval_step = make_eval_step(model.module)
+            self._pad_to_batch = False
         self.vocab_decoder = (VocabDecoder(param.vocab)
                               if param.vocab else None)
 
@@ -73,19 +102,24 @@ class DeepSpeech2Pipeline:
         for audio_id, samples in utterances.items():
             segments.extend(self.segmenter.segment(samples, audio_id))
         feats = np.stack([
-            featurize(s["samples"], utt_length=self.param.utt_length,
+            featurize(s["samples"], utt_length=self.utt_length,
                       n_mels=self.param.n_mels)
             for s in segments
-        ]) if segments else np.zeros((0, self.param.utt_length,
+        ]) if segments else np.zeros((0, self.utt_length,
                                       self.param.n_mels), np.float32)
 
         texts: List[str] = []
         for i in range(0, len(segments), self.param.batch_size):
             chunk = feats[i:i + self.param.batch_size]
+            n_real = chunk.shape[0]
+            if self._pad_to_batch and n_real < self.param.batch_size:
+                pad = np.zeros((self.param.batch_size - n_real,)
+                               + chunk.shape[1:], chunk.dtype)
+                chunk = np.concatenate([chunk, pad])
             log_probs = self._eval_step(self.model.variables,
                                         jnp.asarray(chunk))
             texts.extend(best_path_decode(np.asarray(log_probs[j]))
-                         for j in range(chunk.shape[0]))
+                         for j in range(n_real))
 
         # re-join by (audio_id, audio_seq) (reference InferenceEvaluate
         # groupBy(audio_id).sort(audio_seq) concat)
