@@ -170,6 +170,7 @@ class Model:
         self.module = module
         self.variables = variables
         self._jit_apply = None
+        self._jit_train_apply = None
         self.training = False
 
     # -- lifecycle ---------------------------------------------------------
@@ -203,14 +204,28 @@ class Model:
                 lambda variables, *a: self.module.apply(variables, *a)
             )
         if self.training:
-            # Training-mode forward (batch stats update, dropout) is not
-            # jitted here; the train-step factories in parallel/train.py own
-            # the jitted mutable path.
-            call_kwargs = accepted_kwargs(self.module, {"train": True})
-            out = self.module.apply(
-                self.variables, *inputs, mutable=["batch_stats"],
-                **call_kwargs, **kwargs,
-            )[0]
+            # Training-mode forward (batch-stats update, dropout) is jitted
+            # too: the mutable collection comes back as part of the jit
+            # output and is folded into ``self.variables`` host-side, so
+            # ``model.train().forward(x)`` matches eval-mode performance.
+            # (Full train *steps* still belong to parallel/train.py.)
+            if self._jit_train_apply is None:
+                call_kwargs = accepted_kwargs(self.module, {"train": True})
+
+                def _train_apply(variables, rngs, *a):
+                    return self.module.apply(
+                        variables, *a, mutable=["batch_stats"],
+                        rngs=rngs, **call_kwargs)
+
+                self._jit_train_apply = jax.jit(_train_apply)
+            out, mutated = self._jit_train_apply(
+                self.variables, kwargs.get("rngs"), *inputs)
+            if "batch_stats" in mutated:
+                base = dict(self.variables)
+                base["batch_stats"] = mutated["batch_stats"]
+                self.variables = (FrozenDict(base)
+                                  if isinstance(self.variables, FrozenDict)
+                                  else base)
             return out
         return self._jit_apply(self.variables, *inputs)
 

@@ -27,7 +27,15 @@ from analytics_zoo_tpu.ops.nms import nms
 
 @dataclasses.dataclass(frozen=True)
 class DetectionOutputParam:
-    """Reference ``PostProcessParam`` (``ssd/model/SSDGraph.scala:36``)."""
+    """Reference ``PostProcessParam`` (``ssd/model/SSDGraph.scala:36``).
+
+    ``backend`` selects the per-class NMS implementation: ``"xla"`` (IoU
+    matrix + fori_loop, ``ops/nms.py``) or ``"pallas"`` (VMEM-resident
+    suppression sweep, ``ops/pallas_nms.py`` — runs the real kernel on TPU
+    and falls back to interpret mode elsewhere).  Both implement the same
+    reference semantics (topk-400 pre-filter, greedy IoU suppression,
+    global keep-topk), so outputs agree up to score ties.
+    """
 
     n_classes: int = 21
     background_id: int = 0
@@ -37,6 +45,7 @@ class DetectionOutputParam:
     keep_topk: int = 200
     share_location: bool = True
     clip_boxes: bool = False
+    backend: str = "xla"
 
 
 def detection_output_single(loc: jax.Array, conf: jax.Array,
@@ -75,14 +84,87 @@ def detection_output_single(loc: jax.Array, conf: jax.Array,
 
 
 @partial(jax.jit, static_argnames=("param",))
+def _detection_output_xla(loc: jax.Array, conf: jax.Array, priors: jax.Array,
+                          variances: jax.Array,
+                          param: DetectionOutputParam) -> jax.Array:
+    return jax.vmap(
+        lambda l, c: detection_output_single(l, c, priors, variances, param)
+    )(loc, conf)
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+@partial(jax.jit, static_argnames=("param", "interpret"))
+def _detection_output_pallas(loc: jax.Array, conf: jax.Array,
+                             priors: jax.Array, variances: jax.Array,
+                             param: DetectionOutputParam,
+                             interpret: bool) -> jax.Array:
+    """Batched pallas path: per-class candidate selection stays in XLA
+    (top_k + gathers feed the MXU-side sort network well); the sequential
+    suppression sweep — the part XLA can only express as an O(K·argmax)
+    fori_loop — runs in one VMEM-resident kernel over a (B·C,) grid."""
+    from analytics_zoo_tpu.ops.pallas_nms import nms_sweep
+
+    B, P, C = conf.shape
+    decoded = jax.vmap(
+        lambda l: decode_bbox(priors, variances, l, clip=param.clip_boxes)
+    )(loc)                                                  # (B,P,4)
+
+    scores = jnp.swapaxes(conf, 1, 2)                       # (B,C,P)
+    masked = jnp.where(scores > param.conf_thresh, scores, -jnp.inf)
+    k = min(_round_up(param.nms_topk, 128), _round_up(P, 128))
+    kk = min(k, P)
+    top_scores, top_idx = jax.lax.top_k(masked, kk)         # (B,C,kk)
+    if k - kk:
+        top_scores = jnp.pad(top_scores, ((0, 0), (0, 0), (0, k - kk)),
+                             constant_values=-jnp.inf)
+        top_idx = jnp.pad(top_idx, ((0, 0), (0, 0), (0, k - kk)))
+    boxes = jnp.take_along_axis(decoded[:, None], top_idx[..., None],
+                                axis=2)                     # (B,C,k,4)
+    # reference nmsFast's topk-400 pre-filter: lanes past nms_topk are
+    # padding from rounding k up to the 128-lane multiple
+    valid = (jnp.isfinite(top_scores)
+             & (jnp.arange(k) < param.nms_topk)).astype(jnp.float32)
+
+    def flat(a):
+        return a.reshape(B * C, k)
+
+    keep = nms_sweep(flat(boxes[..., 0]), flat(boxes[..., 1]),
+                     flat(boxes[..., 2]), flat(boxes[..., 3]), flat(valid),
+                     iou_threshold=param.nms_thresh,
+                     interpret=interpret).reshape(B, C, k)
+
+    fg = (jnp.arange(C) != param.background_id).astype(jnp.float32)
+    sel = jnp.where(jnp.isfinite(top_scores), top_scores, 0.0) \
+        * keep * fg[None, :, None]
+    flat_scores = sel.reshape(B, C * k)
+    out_scores, order = jax.lax.top_k(flat_scores, param.keep_topk)  # (B,K)
+    out_cls = order // k
+    out_boxes = jnp.take_along_axis(boxes.reshape(B, C * k, 4),
+                                    order[..., None], axis=1)
+    ok = out_scores > 0
+    return jnp.concatenate([
+        jnp.where(ok, out_cls, -1)[..., None].astype(jnp.float32),
+        out_scores[..., None],
+        jnp.where(ok[..., None], out_boxes, 0.0),
+    ], axis=-1)
+
+
 def detection_output(loc: jax.Array, conf: jax.Array, priors: jax.Array,
                      variances: jax.Array,
                      param: DetectionOutputParam = DetectionOutputParam()
                      ) -> jax.Array:
-    """Batched: loc (B,P,4), conf (B,P,C) → (B, keep_topk, 6)."""
-    return jax.vmap(
-        lambda l, c: detection_output_single(l, c, priors, variances, param)
-    )(loc, conf)
+    """Batched: loc (B,P,4), conf (B,P,C) → (B, keep_topk, 6).
+
+    Dispatches on ``param.backend``; the pallas path compiles the real TPU
+    kernel when a TPU backend is active and interprets elsewhere (CI)."""
+    if param.backend == "pallas":
+        interpret = jax.default_backend() not in ("tpu", "axon")
+        return _detection_output_pallas(loc, conf, priors, variances,
+                                        param=param, interpret=interpret)
+    return _detection_output_xla(loc, conf, priors, variances, param=param)
 
 
 def scale_detections(dets: jax.Array, heights, widths) -> jax.Array:

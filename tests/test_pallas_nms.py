@@ -52,3 +52,44 @@ def test_pallas_nms_max_output_truncates():
     assert mask.sum() == 10
     kept_scores = scores[np.asarray(idx)]
     assert (np.diff(kept_scores) <= 1e-6).all()  # score-ranked
+
+
+class TestDetectionOutputPallasBackend:
+    """The serving-path wiring: DetectionOutputParam(backend='pallas')
+    must agree with the XLA backend end to end (VERDICT round-1 item 6)."""
+
+    def _inputs(self, seed, batch=2, priors_n=160, classes=6):
+        import jax
+        from analytics_zoo_tpu.ops.priorbox import PriorBoxParam, prior_box
+        rng = np.random.RandomState(seed)
+        cx = rng.rand(priors_n, 2).astype(np.float32)
+        wh = (rng.rand(priors_n, 2) * 0.2 + 0.05).astype(np.float32)
+        priors = np.concatenate([cx - wh / 2, cx + wh / 2], 1)
+        variances = np.tile(np.asarray([0.1, 0.1, 0.2, 0.2], np.float32),
+                            (priors_n, 1))
+        loc = (rng.randn(batch, priors_n, 4) * 0.1).astype(np.float32)
+        logits = rng.randn(batch, priors_n, classes).astype(np.float32)
+        conf = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+        return (jnp.asarray(loc), jnp.asarray(conf), jnp.asarray(priors),
+                jnp.asarray(variances))
+
+    @pytest.mark.parametrize("seed", [0, 7])
+    def test_backend_parity(self, seed):
+        from analytics_zoo_tpu.ops.detection_output import (
+            DetectionOutputParam, detection_output)
+        loc, conf, priors, variances = self._inputs(seed)
+        base = dict(n_classes=conf.shape[-1], nms_topk=64, keep_topk=32)
+        ref = detection_output(loc, conf, priors, variances,
+                               DetectionOutputParam(**base, backend="xla"))
+        got = detection_output(loc, conf, priors, variances,
+                               DetectionOutputParam(**base, backend="pallas"))
+        ref, got = np.asarray(ref), np.asarray(got)
+        # identical detections (class, box) row by row; scores to fp tolerance
+        np.testing.assert_array_equal(got[..., 0], ref[..., 0])
+        np.testing.assert_allclose(got[..., 1], ref[..., 1], atol=1e-6)
+        np.testing.assert_allclose(got[..., 2:], ref[..., 2:], atol=1e-6)
+
+    def test_backend_reaches_ssd_predictor_param(self):
+        from analytics_zoo_tpu.ops.detection_output import DetectionOutputParam
+        p = DetectionOutputParam(backend="pallas")
+        assert p.backend == "pallas" and hash(p)  # static-arg usable
