@@ -8,6 +8,7 @@ pipeline").
 from analytics_zoo_tpu.data.transformer import (
     ChainedTransformer,
     FnTransformer,
+    ParallelTransformer,
     Pipeline,
     RandomTransformer,
     Transformer,
@@ -27,5 +28,10 @@ from analytics_zoo_tpu.data.records import (
     write_ssd_records,
 )
 from analytics_zoo_tpu.data.prefetch import PrefetchDataSet, device_prefetch
+from analytics_zoo_tpu.data.synthetic import (
+    SHAPE_CLASSES,
+    generate_shapes_records,
+    render_shapes_image,
+)
 
 __all__ = [k for k in dir() if not k.startswith("_")]

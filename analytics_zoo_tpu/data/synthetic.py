@@ -1,0 +1,91 @@
+"""Rendered-shapes detection dataset — real JPEGs, known ground truth.
+
+The environment has no network egress, so VOC/COCO can't be downloaded;
+this generator stands in as the *real-data path* for benchmarks and
+end-to-end accuracy runs: images are rendered with OpenCV, JPEG-encoded,
+and written as ``.azr`` shards, so every host-side stage the reference
+identifies as HOT LOOP #1 (SURVEY.md §3.1: decode, augmentation chain,
+batching) runs exactly as it would on VOC.  Ground truth is exact by
+construction, so a trained detector's mAP is a true end-to-end
+correctness measurement of the whole train→eval stack (priors, matching,
+loss, decode, NMS, mAP), in the spirit of the reference's golden-value
+test style (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.data.records import SSDByteRecord, write_ssd_records
+
+SHAPE_CLASSES = ("__background__", "rectangle", "ellipse", "triangle")
+
+
+def _jpeg_encode(img: np.ndarray, quality: int = 92) -> bytes:
+    import cv2
+
+    ok, buf = cv2.imencode(".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, quality])
+    if not ok:
+        raise RuntimeError("cv2.imencode failed")
+    return bytes(buf.tobytes())
+
+
+def render_shapes_image(rng: np.random.RandomState, resolution: int = 300,
+                        max_shapes: int = 3,
+                        n_classes: int = len(SHAPE_CLASSES) - 1,
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """One image: textured background + 1..max_shapes colored shapes.
+
+    Returns (BGR uint8 image, gt matrix (N,6) of
+    (label, difficult, x1, y1, x2, y2) in pixel coords — the
+    ``SSDByteRecord`` layout).
+    """
+    import cv2
+
+    res = resolution
+    # low-frequency textured background (so JPEG statistics are realistic)
+    base = rng.randint(0, 120, (res // 10, res // 10, 3), np.uint8)
+    img = cv2.resize(base, (res, res), interpolation=cv2.INTER_CUBIC)
+    img = cv2.GaussianBlur(img, (5, 5), 0)
+
+    n = rng.randint(1, max_shapes + 1)
+    gt: List[List[float]] = []
+    for _ in range(n):
+        cls = rng.randint(1, n_classes + 1)
+        size = rng.randint(res // 6, res // 2)
+        x1 = rng.randint(0, res - size)
+        y1 = rng.randint(0, res - size)
+        w = size
+        h = rng.randint(int(size * 0.6), size + 1)
+        y1 = min(y1, res - h)
+        x2, y2 = x1 + w, y1 + h
+        # bright, saturated color — contrasts the dark background
+        color = tuple(int(c) for c in rng.randint(140, 256, 3))
+        if cls == 1:                      # rectangle
+            cv2.rectangle(img, (x1, y1), (x2, y2), color, -1)
+        elif cls == 2:                    # ellipse
+            cv2.ellipse(img, ((x1 + x2) // 2, (y1 + y2) // 2),
+                        (w // 2, h // 2), 0, 0, 360, color, -1)
+        else:                             # triangle
+            pts = np.array([[(x1 + x2) // 2, y1], [x1, y2 - 1], [x2 - 1, y2 - 1]],
+                           np.int32)
+            cv2.fillPoly(img, [pts], color)
+        gt.append([float(cls), 0.0, float(x1), float(y1),
+                   float(x2 - 1), float(y2 - 1)])
+    return img, np.asarray(gt, np.float32)
+
+
+def generate_shapes_records(prefix: str, n_images: int = 800,
+                            resolution: int = 300, num_shards: int = 4,
+                            seed: int = 0, max_shapes: int = 3,
+                            jpeg_quality: int = 92) -> List[str]:
+    """Render → JPEG-encode → write ``.azr`` shards.  Returns shard paths."""
+    rng = np.random.RandomState(seed)
+    records = []
+    for i in range(n_images):
+        img, gt = render_shapes_image(rng, resolution, max_shapes)
+        records.append(SSDByteRecord(data=_jpeg_encode(img, jpeg_quality),
+                                     path=f"shapes/{i:06d}.jpg", gt=gt))
+    return write_ssd_records(records, prefix, num_shards)

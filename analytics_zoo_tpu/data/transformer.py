@@ -12,7 +12,11 @@ stream), compose with ``>>`` (the ``->`` of the reference), and are cheaply
 from __future__ import annotations
 
 import copy
+import os
 import random
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 
 
@@ -36,7 +40,38 @@ class Transformer:
         return ChainedTransformer(self, other)
 
     def clone(self) -> "Transformer":
-        return copy.deepcopy(self)
+        """Deep copy with INDEPENDENT randomness — the reference's
+        ``cloneTransformer`` contract (``common/Predictor.scala:82-86``:
+        per-worker clones must not replay each other's augmentation
+        decisions).  deepcopy duplicates Mersenne state exactly, so any
+        held RNG is reseeded from the OS entropy pool."""
+        c = copy.deepcopy(self)
+        _reseed_rngs(c)
+        return c
+
+
+def _reseed_rngs(obj: Any, _seen: Optional[set] = None) -> None:
+    import numpy as _np
+
+    if _seen is None:
+        _seen = set()
+    if id(obj) in _seen:
+        return
+    _seen.add(id(obj))
+    if isinstance(obj, random.Random):
+        obj.seed(int.from_bytes(os.urandom(8), "little"))
+        return
+    if isinstance(obj, _np.random.RandomState):
+        obj.seed(int.from_bytes(os.urandom(4), "little"))
+        return
+    if isinstance(obj, dict):
+        for v in obj.values():
+            _reseed_rngs(v, _seen)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            _reseed_rngs(v, _seen)
+    elif hasattr(obj, "__dict__"):
+        _reseed_rngs(vars(obj), _seen)
 
 
 class ChainedTransformer(Transformer):
@@ -77,6 +112,53 @@ class FnTransformer(Transformer):
 
     def transform(self, sample):
         return self.fn(sample)
+
+
+class ParallelTransformer(Transformer):
+    """Run a 1→1 transformer over a thread pool — the host-augmentation
+    throughput answer to SURVEY.md §7.3 ("ColorJitter/RandomSampler per
+    image on CPU can starve a v5e host").
+
+    The reference parallelises the same work by cloning the transformer
+    once per Spark executor core (``common/Predictor.scala:82-86``,
+    ``RoiImageSeqGenerator.scala`` multi-threaded writer); here each pool
+    thread lazily ``clone()``s the inner transformer so RNG and scratch
+    buffers stay thread-private.  OpenCV/NumPy release the GIL, so threads
+    give real parallelism without pickling images across processes.
+    Output order is preserved (a bounded sliding window of futures, so
+    memory stays O(workers + lookahead)).
+    """
+
+    def __init__(self, inner: Transformer, workers: int = 8,
+                 max_pending: Optional[int] = None):
+        self.inner = inner
+        self.workers = max(1, workers)
+        self.max_pending = max_pending or 2 * self.workers
+
+    def apply_iter(self, it: Iterator[Any]) -> Iterator[Any]:
+        if self.workers == 1:
+            yield from self.inner.apply_iter(it)
+            return
+        local = threading.local()
+
+        def run(sample):
+            t = getattr(local, "t", None)
+            if t is None:
+                t = local.t = self.inner.clone()
+            return t.transform(sample)
+
+        with ThreadPoolExecutor(self.workers) as ex:
+            pending: deque = deque()
+            for sample in it:
+                pending.append(ex.submit(run, sample))
+                if len(pending) >= self.max_pending:
+                    out = pending.popleft().result()
+                    if out is not None:
+                        yield out
+            while pending:
+                out = pending.popleft().result()
+                if out is not None:
+                    yield out
 
 
 class RandomTransformer(Transformer):

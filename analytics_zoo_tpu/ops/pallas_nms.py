@@ -36,11 +36,14 @@ def _nms_kernel(x1_ref, y1_ref, x2_ref, y2_ref, valid_ref, keep_ref,
     """One class: sweep sorted candidates, suppress by IoU.
 
     TPU VMEM has no scalar stores, so all per-candidate reads/writes are
-    masked full-row VPU ops over the (1, K) lane vectors.
+    masked full-row VPU ops over the (1, 1, K) lane vectors.  (The refs
+    are 3-D because Mosaic requires the trailing two block dims to be
+    (8k, 128k) or exactly the array dims — a (1, 1, K) block over a
+    (C, 1, K) array satisfies the "exact" rule per class.)
     """
-    active_ref[:] = valid_ref[:]                    # (1, K) 1.0 = in play
+    active_ref[:] = valid_ref[:]                    # (1, 1, K) 1.0 = in play
     keep_ref[:] = jnp.zeros_like(keep_ref)
-    lane = jax.lax.broadcasted_iota(jnp.int32, (1, k), 1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, 1, k), 2)
 
     def pick(ref, is_i):
         return jnp.sum(jnp.where(is_i, ref[:], 0.0))
@@ -85,18 +88,20 @@ def nms_sweep(x1, y1, x2, y2, valid, iou_threshold: float = 0.45,
     C, K = x1.shape
     kernel = functools.partial(_nms_kernel, iou_threshold=iou_threshold, k=K,
                                off=0.0 if normalized else 1.0)
-    spec = pl.BlockSpec((1, K), lambda c: (c, 0), memory_space=pltpu.VMEM)
-    return pl.pallas_call(
+    spec = pl.BlockSpec((1, 1, K), lambda c: (c, 0, 0),
+                        memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
         kernel,
         grid=(C,),
         in_specs=[spec] * 5,
         out_specs=spec,
-        out_shape=jax.ShapeDtypeStruct((C, K), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((1, K), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct((C, 1, K), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((1, 1, K), jnp.float32)],
         interpret=interpret,
-    )(x1.astype(jnp.float32), y1.astype(jnp.float32),
-      x2.astype(jnp.float32), y2.astype(jnp.float32),
-      valid.astype(jnp.float32))
+    )(x1.astype(jnp.float32)[:, None, :], y1.astype(jnp.float32)[:, None, :],
+      x2.astype(jnp.float32)[:, None, :], y2.astype(jnp.float32)[:, None, :],
+      valid.astype(jnp.float32)[:, None, :])
+    return out[:, 0, :]
 
 
 def _round_up(n: int, m: int) -> int:

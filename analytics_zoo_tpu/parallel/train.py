@@ -322,11 +322,14 @@ class Optimizer:
     def __init__(self, model: Model, dataset, criterion, mesh=None,
                  skip_loss_above: Optional[float] = None,
                  grad_clip_norm: Optional[float] = None,
-                 compute_dtype=None):
+                 compute_dtype=None, device_transform=None):
         self.model = model
         self.dataset = dataset
         self.criterion = criterion
         self.compute_dtype = compute_dtype
+        # jitted on-device batch rewrite (e.g. the device-augmentation
+        # program, transform/vision/device.py) applied after sharding
+        self.device_transform = device_transform
         self.mesh = mesh or mesh_lib.create_mesh()
         self.optim: OptimMethod = Adam(1e-3)
         self.end_when: Trigger = Trigger.max_epoch(1)
@@ -396,6 +399,8 @@ class Optimizer:
             for batch in self.dataset:
                 n = _batch_size(batch)
                 dev_batch = mesh_lib.shard_batch(batch, self.mesh)
+                if self.device_transform is not None:
+                    dev_batch = self.device_transform(dev_batch)
                 state, metrics = train_step(state, dev_batch, self.optim.lr_scale)
                 loop.iteration += 1
                 records += n

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from analytics_zoo_tpu.core.module import Model
 from analytics_zoo_tpu.data import (
     DataSet,
+    ParallelTransformer,
     RandomTransformer,
     SSDByteRecord,
     Transformer,
@@ -79,6 +80,9 @@ class PreProcessParam:
     pixel_means: Sequence[float] = BGR_MEANS
     n_partition: int = 1
     max_gt: int = 100
+    # host augmentation worker threads (SURVEY.md §7.3 hard part 4);
+    # 1 = serial (deterministic order), >1 = ParallelTransformer pool
+    num_workers: int = 1
 
 
 class RecordToFeature(Transformer):
@@ -172,16 +176,44 @@ def val_transformer(param: PreProcessParam) -> Transformer:
     )
 
 
+def _maybe_parallel(t: Transformer, workers: int) -> Transformer:
+    return ParallelTransformer(t, workers) if workers > 1 else t
+
+
+def load_train_set_device(pattern: str, param: PreProcessParam,
+                          aug: Optional["DeviceAugParam"] = None):
+    """Device-augmentation train path (``transform/vision/device.py``):
+    host does decode + geometry/label math; all pixel work runs on-chip.
+    Returns (DataSet of staging batches, jitted augment fn) — apply the
+    fn to each batch *after* ``device_prefetch``."""
+    from analytics_zoo_tpu.transform.vision import (DeviceAugBatch,
+                                                    DeviceAugParam,
+                                                    DeviceAugPrepare,
+                                                    make_device_augment)
+
+    aug = aug or DeviceAugParam(resolution=param.resolution,
+                                pixel_means=tuple(param.pixel_means))
+    chain = (RecordToFeature() >> BytesToMat() >> RoiNormalize()
+             >> DeviceAugPrepare(aug))
+    ds = (DataSet.from_record_files(pattern, SSDByteRecord.decode,
+                                    shuffle_files=True)
+          .transform(_maybe_parallel(chain, param.num_workers))
+          .transform(DeviceAugBatch(param.batch_size, param.max_gt)))
+    return ds, make_device_augment(aug)
+
+
 def load_train_set(pattern: str, param: PreProcessParam) -> DataSet:
     return (DataSet.from_record_files(pattern, SSDByteRecord.decode,
                                       shuffle_files=True)
-            .transform(train_transformer(param))
+            .transform(_maybe_parallel(train_transformer(param),
+                                       param.num_workers))
             .transform(RoiImageToBatch(param.batch_size, param.max_gt)))
 
 
 def load_val_set(pattern: str, param: PreProcessParam) -> DataSet:
     return (DataSet.from_record_files(pattern, SSDByteRecord.decode)
-            .transform(val_transformer(param))
+            .transform(_maybe_parallel(val_transformer(param),
+                                       param.num_workers))
             .transform(RoiImageToBatch(param.batch_size, param.max_gt,
                                        drop_remainder=False)))
 
@@ -228,7 +260,8 @@ class SSDPredictor:
 
     def predict(self, records) -> List[np.ndarray]:
         """records: iterable of SSDByteRecord → per-image (K, 6) arrays."""
-        chain = (val_transformer(self.param)
+        chain = (_maybe_parallel(val_transformer(self.param),
+                                 self.param.num_workers)
                  >> RoiImageToBatch(self.param.batch_size, keep_label=False,
                                     drop_remainder=False))
         out: List[np.ndarray] = []

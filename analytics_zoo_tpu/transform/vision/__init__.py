@@ -46,5 +46,11 @@ from analytics_zoo_tpu.transform.vision.sampler import (
     generate_batch_samples,
     standard_samplers,
 )
+from analytics_zoo_tpu.transform.vision.device import (
+    DeviceAugBatch,
+    DeviceAugParam,
+    DeviceAugPrepare,
+    make_device_augment,
+)
 
 __all__ = [k for k in dir() if not k.startswith("_")]

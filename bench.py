@@ -1,92 +1,365 @@
-"""Benchmark runner — prints ONE JSON line for the driver.
+"""Benchmark runner — one JSON line per BASELINE.json metric; the LAST
+line is the headline (SSD300 train images/sec/chip) for the driver.
 
-Measures SSD300-VGG data-parallel training throughput (images/sec/chip),
-the headline metric from BASELINE.json ("SSD300 images/sec/chip").  The
-reference publishes no absolute numbers (BASELINE.md: mechanism only), so
-``vs_baseline`` compares against the reference's *cluster-shape anchor*:
-the SSD README's 4×28-core Xeon training setup, credited at an optimistic
-~0.5 img/s/core → 56 images/sec total — i.e. vs_baseline = ours / 56.
+Unlike the round-1 harness, every measurement here is end-to-end honest:
 
-Usage: ``python bench.py [--batch N] [--steps N] [--warmup N] [--res 300]``
-Runs on whatever jax.devices() provides (1 real TPU chip under the driver).
+* **ssd300_train** feeds real JPEG-encoded images through the *full*
+  canonical augmentation chain (``load_train_set``: decode → RoiNormalize
+  → ColorJitter → Expand → RandomSampler → Resize → HFlip → MatToFloats,
+  reference ``ssd/Utils.scala:56``) with ``ParallelTransformer`` host
+  workers + ``device_prefetch`` double-buffering, into the bf16
+  mixed-precision jitted train step.  HOT LOOP #1 (SURVEY.md §3.1) is
+  inside the measurement.
+* **ssd300_serve** measures the serving path — decode + preprocess +
+  forward + in-graph DetectionOutput (decode/NMS/topk) + rescale —
+  via ``SSDPredictor.predict`` (reference ``SSDPredictor.scala:54``).
+* **ds2** measures utterances/sec through the whole ASR pipeline:
+  segment → host FFT/mel featurization → batched forward → CTC greedy
+  decode → (id,seq) re-join (reference ``InferenceEvaluate.scala`` wall
+  time; the reference ran this batch-1 inside a DataFrame udf).
+* **detection_output pallas vs xla**: correctness + microbench of the
+  Pallas NMS kernel on the real chip (reference ``Nms.scala:131``).
+* **MFU**: achieved model TFLOP/s from XLA's compiled cost analysis,
+  against the chip's advertised bf16 peak (v5e ≈ 197 TFLOP/s).
+
+``vs_baseline`` anchors: the reference publishes NO absolute numbers
+(SURVEY.md §6).  For the headline we keep the round-1 *labeled estimate*:
+the SSD README's 4×28-core Xeon train cluster credited at an optimistic
+~0.5 img/s/core → 56 img/s total.  Lines without a defensible anchor set
+``vs_baseline`` to our own round-1 number (regression tracking) or null.
+
+Usage: ``python bench.py [--quick] [--skip ssd_train,...]``
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import tempfile
 import time
 
 
-REFERENCE_ANCHOR_IMAGES_PER_SEC = 56.0  # 4 executors x 28 cores x ~0.5 img/s
+# Labeled estimate, NOT a published number: 4 executors x 28 cores x
+# ~0.5 img/s/core (reference pipeline/ssd/README.md cluster shape).
+REFERENCE_ANCHOR_IMAGES_PER_SEC = 56.0
+ROUND1_TRAIN_IMG_S = 365.75          # BENCH_r01.json (synthetic-batch harness)
+
+# advertised bf16 peak matmul throughput per chip
+PEAK_TFLOPS = {
+    "TPU v5 lite": 197.0,            # v5e
+    "TPU v5e": 197.0,
+    "TPU v4": 275.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,            # v6e / Trillium
+}
+
+
+def _emit(metric: str, value: float, unit: str, vs_baseline, **extra):
+    line = {"metric": metric, "value": round(float(value), 3), "unit": unit,
+            "vs_baseline": (round(float(vs_baseline), 3)
+                            if vs_baseline is not None else None)}
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+    return line
+
+
+def _flops_per_step(step_fn, *example_args) -> float:
+    """XLA's own FLOP count for the compiled train step (fwd+bwd+update)."""
+    try:
+        compiled = step_fn.lower(*example_args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca.get("flops", 0.0))
+    except Exception:
+        return 0.0
+
+
+def bench_ssd_train(args, mesh, shard_pattern, device_aug: bool):
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.data import device_prefetch
+    from analytics_zoo_tpu.models import SSDVgg, build_priors, ssd300_config
+    from analytics_zoo_tpu.ops import MultiBoxLoss, MultiBoxLossParam
+    from analytics_zoo_tpu.parallel import (
+        SGD, create_train_state, make_train_step, replicate)
+    from analytics_zoo_tpu.pipelines.ssd import (
+        PreProcessParam, load_train_set, load_train_set_device)
+
+    n_chips = jax.device_count()
+    res = args.res
+    model = Model(SSDVgg(num_classes=args.classes, resolution=res))
+    model.build(0, jnp.zeros((1, res, res, 3), jnp.float32))
+    priors, variances = build_priors(ssd300_config())
+    criterion = MultiBoxLoss(priors, variances,
+                             MultiBoxLossParam(n_classes=args.classes))
+    optim = SGD(1e-3, momentum=0.9)
+    state = replicate(create_train_state(model, optim), mesh)
+    # no skip_loss_above guard: it is fine-tuning semantics and would mask
+    # every update of this from-scratch model (loss starts ~100 > 50),
+    # making the reported final_loss a frozen artifact
+    step = make_train_step(model.module, criterion, optim, mesh=mesh,
+                           compute_dtype=args.compute_dtype)
+
+    param = PreProcessParam(batch_size=args.batch, resolution=res,
+                            num_workers=args.workers, max_gt=8)
+    if device_aug:
+        dataset, augment = load_train_set_device(shard_pattern, param)
+    else:
+        dataset, augment = load_train_set(shard_pattern, param), None
+
+    def batches():   # epoch-looping stream, prefetched to device
+        while True:
+            for b in device_prefetch(iter(dataset), mesh):
+                yield augment(b) if augment is not None else b
+
+    stream = batches()
+    first = next(stream)
+    state, metrics = step(state, first, 1.0)      # compile
+    for _ in range(max(args.warmup - 1, 0)):
+        state, metrics = step(state, next(stream), 1.0)
+    jax.block_until_ready(metrics["loss"])
+
+    dt_step = None
+    if device_aug:
+        # compute-only: same batch re-fed (the round-1 measure, now
+        # clearly labeled) — the device-step ceiling, pipeline excluded
+        flops = _flops_per_step(step, state, first, 1.0)
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            state, metrics = step(state, first, 1.0)
+        jax.block_until_ready(metrics["loss"])
+        dt_step = time.perf_counter() - t0
+        step_per_chip = args.batch * args.steps / dt_step / max(n_chips, 1)
+        _emit("ssd300_train_step_images_per_sec_per_chip", step_per_chip,
+              "images/sec/chip", step_per_chip / ROUND1_TRAIN_IMG_S,
+              note="device step only (batch re-fed) — input pipeline "
+                   "excluded; vs_baseline = vs round-1 synthetic harness "
+                   "(fp32→bf16)")
+        kind = jax.devices()[0].device_kind
+        peak = PEAK_TFLOPS.get(kind)
+        if flops > 0:
+            tflops = flops / (dt_step / args.steps) / 1e12 / max(n_chips, 1)
+            _emit("ssd300_train_model_tflops_per_chip", tflops,
+                  "TFLOP/s/chip", tflops / peak if peak else None,
+                  mfu=round(tflops / peak, 4) if peak else None,
+                  peak_tflops=peak, device_kind=kind,
+                  note="fwd+bwd+update FLOPs from XLA compiled "
+                       "cost_analysis over the compute-only step time; "
+                       "vs_baseline = MFU against advertised bf16 peak")
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, metrics = step(state, next(stream), 1.0)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+    loss = float(metrics["loss"])
+
+    images_per_sec = args.batch * args.steps / dt
+    per_chip = images_per_sec / max(n_chips, 1)
+    if device_aug:
+        _emit("ssd300_train_host_bound_fraction",
+              max(0.0, 1.0 - (dt_step / dt)), "fraction", None,
+              host_cpus=os.cpu_count(),
+              note="1 - step_time/e2e_time with device-side augmentation "
+                   "(this VM exposes few host cores; a real v5e TPU-VM "
+                   "host has ~112)")
+    else:
+        _emit("ssd300_train_hostaug_images_per_sec_per_chip", per_chip,
+              "images/sec/chip", None, host_cpus=os.cpu_count(),
+              note="reference-style host (OpenCV) augmentation chain "
+                   "end-to-end — compare with the device-aug headline")
+    return per_chip, images_per_sec, loss
+
+
+def bench_ssd_serve(args, mesh, records):
+    import jax
+
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.models import SSDVgg
+    from analytics_zoo_tpu.ops import DetectionOutputParam
+    from analytics_zoo_tpu.pipelines.ssd import PreProcessParam, SSDPredictor
+
+    res = args.res
+    model = Model(SSDVgg(num_classes=args.classes, resolution=res))
+    model.build(0, jnp.zeros((1, res, res, 3), jnp.float32))
+    param = PreProcessParam(batch_size=args.batch, resolution=res,
+                            num_workers=args.workers)
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    predictor = SSDPredictor(
+        model, param,
+        post=DetectionOutputParam(n_classes=args.classes, backend="auto"),
+        compute_dtype=args.compute_dtype)
+
+    warm = predictor.predict(records[:args.batch])           # compile
+    assert len(warm) == args.batch
+    t0 = time.perf_counter()
+    out = predictor.predict(records)
+    dt = time.perf_counter() - t0
+    assert len(out) == len(records)
+    per_sec = len(records) / dt
+    per_chip = per_sec / max(jax.device_count(), 1)
+    return _emit("ssd300_serve_images_per_sec_per_chip", per_chip,
+                 "images/sec/chip", None,
+                 nms_backend="pallas" if on_tpu else "xla",  # auto-resolved
+                 note="decode+preprocess+forward+DetectionOutput+rescale; "
+                      "no published reference anchor")
+
+
+def bench_detection_output_backends(args):
+    """Pallas NMS vs XLA NMS on the same batch: parity + speed, on the
+    real chip (VERDICT round-1 item 6)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from analytics_zoo_tpu.models import build_priors, ssd300_config
+    from analytics_zoo_tpu.ops import DetectionOutputParam, detection_output
+
+    priors, variances = build_priors(ssd300_config())
+    n_p = priors.shape[0]
+    rng = np.random.RandomState(0)
+    b = max(2, args.batch // 4)
+    loc = jnp.asarray(rng.randn(b, n_p, 4).astype(np.float32) * 0.1)
+    logits = rng.randn(b, n_p, args.classes).astype(np.float32)
+    logits[:, :, 0] += 2.0                     # mostly background, as served
+    conf = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+
+    outs, times = {}, {}
+    for backend in ("xla", "pallas"):
+        p = DetectionOutputParam(n_classes=args.classes, backend=backend)
+        f = jax.jit(lambda l, c, p=p: detection_output(
+            l, c, jnp.asarray(priors), jnp.asarray(variances), p))
+        o = f(loc, conf)
+        jax.block_until_ready(o)               # compile + correctness run
+        t0 = time.perf_counter()
+        for _ in range(args.nms_iters):
+            o = f(loc, conf)
+        jax.block_until_ready(o)
+        times[backend] = (time.perf_counter() - t0) / args.nms_iters
+        outs[backend] = np.asarray(o)
+
+    # parity: kept-detection scores should agree (box sets can differ at
+    # score ties); compare sorted score vectors per image
+    sx = np.sort(outs["xla"][..., 1], axis=-1)
+    sp = np.sort(outs["pallas"][..., 1], axis=-1)
+    parity = float(np.abs(sx - sp).max())
+    speedup = times["xla"] / max(times["pallas"], 1e-12)
+    return _emit("detection_output_pallas_speedup_vs_xla", speedup, "x",
+                 None, parity_max_score_diff=round(parity, 5),
+                 xla_ms=round(times["xla"] * 1e3, 3),
+                 pallas_ms=round(times["pallas"] * 1e3, 3),
+                 backend=jax.default_backend())
+
+
+def bench_ds2(args, mesh):
+    import jax
+    import numpy as np
+
+    from analytics_zoo_tpu.pipelines.deepspeech2 import (
+        DS2Param, DeepSpeech2Pipeline, make_ds2_model)
+
+    param = DS2Param(segment_seconds=args.ds2_seconds,
+                     batch_size=args.ds2_batch)
+    model = make_ds2_model(hidden=args.ds2_hidden,
+                           n_rnn_layers=args.ds2_layers,
+                           utt_length=param.utt_length)
+    pipe = DeepSpeech2Pipeline(model, param)
+
+    rng = np.random.RandomState(0)
+    n_utt = args.ds2_utts
+    sec = args.ds2_seconds
+    utts = {f"utt{i:03d}": rng.randn(16000 * sec).astype(np.float32) * 0.1
+            for i in range(n_utt)}
+
+    pipe.transcribe_samples({"warm": utts["utt000"]})        # compile
+    t0 = time.perf_counter()
+    out = pipe.transcribe_samples(utts)
+    dt = time.perf_counter() - t0
+    assert len(out) == n_utt
+    per_sec = n_utt / dt
+    audio_rtf = n_utt * sec / dt
+    return _emit("ds2_utterances_per_sec", per_sec, "utterances/sec", None,
+                 utterance_seconds=sec, realtime_factor=round(audio_rtf, 1),
+                 note="segment+FFT/mel featurize+forward+CTC decode+rejoin; "
+                      "reference logs wall time only (batch-1 udf)")
 
 
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=32)
-    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--steps", type=int, default=30)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--res", type=int, default=300)
     p.add_argument("--classes", type=int, default=21)
+    p.add_argument("--workers", type=int, default=max(os.cpu_count() or 8, 8))
+    p.add_argument("--n-images", type=int, default=1024)
+    p.add_argument("--compute-dtype", default="bf16")
+    p.add_argument("--nms-iters", type=int, default=20)
+    p.add_argument("--ds2-seconds", type=int, default=15)
+    p.add_argument("--ds2-batch", type=int, default=8)
+    p.add_argument("--ds2-hidden", type=int, default=1024)
+    p.add_argument("--ds2-layers", type=int, default=3)
+    p.add_argument("--ds2-utts", type=int, default=32)
+    p.add_argument("--quick", action="store_true",
+                   help="tiny shapes/models for CI smoke (CPU-friendly)")
+    p.add_argument("--skip", default="",
+                   help="comma list: ssd_serve,ds2,nms,ssd_train,"
+                        "ssd_train_hostaug")
     args = p.parse_args()
+    if args.quick:
+        args.batch, args.steps, args.warmup, args.n_images = 4, 3, 1, 32
+        args.ds2_hidden, args.ds2_layers, args.ds2_utts = 64, 1, 2
+        args.ds2_seconds, args.ds2_batch, args.nms_iters = 2, 2, 2
+        args.workers = 4
+    skip = set(s for s in args.skip.split(",") if s)
 
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
+    from analytics_zoo_tpu.data import generate_shapes_records, read_ssd_records
+    from analytics_zoo_tpu.parallel import create_mesh
 
-    from analytics_zoo_tpu.core.module import Model
-    from analytics_zoo_tpu.models import SSDVgg, build_priors, ssd300_config
-    from analytics_zoo_tpu.ops import MultiBoxLoss
-    from analytics_zoo_tpu.parallel import (
-        SGD,
-        create_mesh,
-        create_train_state,
-        make_train_step,
-        replicate,
-        shard_batch,
-    )
-
-    n_chips = jax.device_count()
     mesh = create_mesh()
-    model = Model(SSDVgg(num_classes=args.classes, resolution=args.res))
-    model.build(0, jnp.zeros((1, args.res, args.res, 3), jnp.float32))
-    priors, variances = build_priors(ssd300_config())
-    criterion = MultiBoxLoss(priors, variances)
-    optim = SGD(1e-3, momentum=0.9)
-    state = replicate(create_train_state(model, optim), mesh)
-    step = make_train_step(model.module, criterion, optim, mesh=mesh)
+    import jax
 
-    rng = np.random.RandomState(0)
-    batch = {
-        "input": rng.rand(args.batch, args.res, args.res, 3).astype(np.float32),
-        "target": {
-            "bboxes": np.tile(np.asarray([0.1, 0.1, 0.6, 0.6], np.float32),
-                              (args.batch, 8, 1)),
-            "labels": rng.randint(1, args.classes, (args.batch, 8)).astype(np.int32),
-            "mask": np.ones((args.batch, 8), np.float32),
-        },
-    }
-    dev_batch = shard_batch(batch, mesh)
+    n_dev = jax.device_count()
+    if args.batch % n_dev:          # batch shards over the data axis
+        args.batch = ((args.batch + n_dev - 1) // n_dev) * n_dev
+    needs_shards = {"ssd_serve", "ssd_train", "ssd_train_hostaug"} - skip
+    with tempfile.TemporaryDirectory() as tmp:
+        pattern = os.path.join(tmp, "shapes-*.azr")
+        records = []
+        if needs_shards:
+            shards = generate_shapes_records(
+                os.path.join(tmp, "shapes"), n_images=args.n_images,
+                resolution=args.res, num_shards=8, seed=0)
+            records = list(read_ssd_records(shards))
 
-    for _ in range(max(args.warmup, 1)):   # ≥1: first call pays compile
-        state, metrics = step(state, dev_batch, 1.0)
-    jax.block_until_ready(metrics["loss"])
-
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        state, metrics = step(state, dev_batch, 1.0)
-    jax.block_until_ready(metrics["loss"])
-    dt = time.perf_counter() - t0
-
-    images_per_sec = args.batch * args.steps / dt
-    per_chip = images_per_sec / max(n_chips, 1)
-    print(json.dumps({
-        "metric": "ssd300_train_images_per_sec_per_chip",
-        "value": round(per_chip, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(images_per_sec / REFERENCE_ANCHOR_IMAGES_PER_SEC, 3),
-    }))
+        if "ssd_serve" not in skip:
+            bench_ssd_serve(args, mesh, records[:min(len(records), 256)])
+        if "nms" not in skip:
+            bench_detection_output_backends(args)
+        if "ds2" not in skip:
+            bench_ds2(args, mesh)
+        if "ssd_train_hostaug" not in skip:
+            bench_ssd_train(args, mesh, pattern, device_aug=False)
+        if "ssd_train" not in skip:
+            per_chip, total, loss = bench_ssd_train(args, mesh, pattern,
+                                                    device_aug=True)
+            _emit("ssd300_train_images_per_sec_per_chip", per_chip,
+                  "images/sec/chip",
+                  total / REFERENCE_ANCHOR_IMAGES_PER_SEC,
+                  final_loss=round(loss, 3),
+                  vs_round1_synthetic=round(per_chip / ROUND1_TRAIN_IMG_S, 3),
+                  anchor="LABELED ESTIMATE ~56 img/s: reference 4x28-core "
+                         "Xeon cluster @ ~0.5 img/s/core; reference "
+                         "publishes no absolute numbers (SURVEY.md §6). "
+                         "Full input pipeline (device-side augmentation "
+                         "path) inside the measurement.")
     return 0
 
 

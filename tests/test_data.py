@@ -121,3 +121,54 @@ def test_default_collate_nested():
     assert out["a"].shape == (4, 3)
     assert out["b"][0].shape == (4, 2)
     assert out["b"][1].shape == (4,)
+
+
+def test_parallel_transformer_matches_serial():
+    from analytics_zoo_tpu.data import ParallelTransformer
+
+    chain = FnTransformer(lambda x: x * 2) >> FnTransformer(lambda x: x + 1)
+    serial = list(chain(range(100)))
+    par = list(ParallelTransformer(chain, workers=4)(range(100)))
+    assert par == serial  # order preserved
+
+
+def test_parallel_transformer_drops_none_and_clones_state():
+    from analytics_zoo_tpu.data import ParallelTransformer
+
+    class Scratch(Transformer):
+        """Stateful scratch buffer: races would corrupt results if the
+        pool shared one instance instead of per-thread clones."""
+
+        def __init__(self):
+            self.buf = np.zeros(4)
+
+        def transform(self, x):
+            if x % 7 == 0:
+                return None
+            self.buf[:] = x          # thread-private scratch
+            return float(self.buf.sum())
+
+    expected = [4.0 * x for x in range(200) if x % 7 != 0]
+    got = list(ParallelTransformer(Scratch(), workers=8)(range(200)))
+    assert got == expected
+
+
+def test_parallel_transformer_single_worker_passthrough():
+    from analytics_zoo_tpu.data import ParallelTransformer
+
+    t = ParallelTransformer(FnTransformer(lambda x: -x), workers=1)
+    assert list(t([1, 2, 3])) == [-1, -2, -3]
+
+
+def test_clone_reseeds_rng():
+    """clone() must yield INDEPENDENT randomness (the cloneTransformer
+    contract): deepcopy alone would replay identical Mersenne streams in
+    every parallel worker."""
+    import random as _random
+
+    inner = RandomTransformer(FnTransformer(lambda x: -x), prob=0.5,
+                              rng=_random.Random(42))
+    a, b = inner.clone(), inner.clone()
+    sa = [a.rng.random() for _ in range(32)]
+    sb = [b.rng.random() for _ in range(32)]
+    assert sa != sb

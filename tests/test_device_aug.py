@@ -1,0 +1,159 @@
+"""Device-augmentation parity tests (transform/vision/device.py).
+
+Pins the device path's pixel semantics against the host/OpenCV chain:
+HSV color math, bilinear crop+resize, mean-border (Expand) fill, flip,
+and the end-to-end staging → jitted-augment batch path.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.transform.vision.device import (
+    DeviceAugBatch,
+    DeviceAugParam,
+    DeviceAugPrepare,
+    _bgr_to_hsv,
+    _hsv_to_bgr,
+    _jitter_one,
+    _sample_one,
+    make_device_augment,
+)
+
+cv2 = pytest.importorskip("cv2")
+
+MEANS = jnp.asarray([104.0, 117.0, 123.0])
+
+
+def test_hsv_roundtrip_matches_cv2():
+    rng = np.random.RandomState(0)
+    img = rng.randint(0, 256, (16, 16, 3)).astype(np.float32)
+    h, s, v = _bgr_to_hsv(jnp.asarray(img))
+    ref = cv2.cvtColor(img.astype(np.uint8), cv2.COLOR_BGR2HSV)
+    dh = np.abs(np.asarray(h) - ref[..., 0].astype(np.float32))
+    dh = np.minimum(dh, 180.0 - dh)                       # hue wraps at 180
+    assert dh.max() <= 1.5
+    assert np.abs(np.asarray(s) - ref[..., 1].astype(np.float32)).max() <= 2.0
+    assert np.abs(np.asarray(v) - ref[..., 2].astype(np.float32)).max() <= 1e-3
+    back = _hsv_to_bgr(h, s, v)
+    assert np.abs(np.asarray(back) - img).max() <= 1.0  # float path, no quant
+
+
+def test_sample_interior_crop_matches_cv2_linear():
+    rng = np.random.RandomState(1)
+    img = rng.randint(0, 256, (64, 80, 3)).astype(np.float32)
+    rect = jnp.asarray([10.0, 8.0, 58.0, 40.0])
+    out = _sample_one(jnp.asarray(img), rect, jnp.asarray([64.0, 80.0]),
+                      jnp.asarray(0.0), 32, MEANS)
+    crop = img[8:40, 10:58]
+    ref = cv2.resize(crop, (32, 32), interpolation=cv2.INTER_LINEAR)
+    assert np.abs(np.asarray(out) - ref).max() <= 2.0
+
+
+def test_sample_outside_rect_fills_means():
+    img = jnp.ones((32, 32, 3)) * 200.0
+    rect = jnp.asarray([-100.0, -100.0, -40.0, -40.0])  # fully outside
+    out = _sample_one(img, rect, jnp.asarray([32.0, 32.0]), jnp.asarray(0.0),
+                      8, MEANS)
+    assert np.allclose(np.asarray(out), np.asarray(MEANS)[None, None, :])
+
+
+def test_sample_expand_border_mix():
+    """A rect 2x the image (zoom-out): corners are mean fill, the center
+    region preserves image pixels — the Expand semantics without ever
+    materializing the canvas."""
+    img = jnp.ones((40, 40, 3)) * 250.0
+    rect = jnp.asarray([-20.0, -20.0, 60.0, 60.0])
+    out = np.asarray(_sample_one(img, rect, jnp.asarray([40.0, 40.0]),
+                                 jnp.asarray(0.0), 80, MEANS))
+    assert np.allclose(out[0, 0], np.asarray(MEANS))        # corner: fill
+    assert np.allclose(out[40, 40], 250.0, atol=1.0)        # center: image
+
+
+def test_sample_hflip():
+    rng = np.random.RandomState(2)
+    img = rng.randint(0, 256, (32, 32, 3)).astype(np.float32)
+    rect = jnp.asarray([0.0, 0.0, 32.0, 32.0])
+    size = jnp.asarray([32.0, 32.0])
+    a = _sample_one(jnp.asarray(img), rect, size, jnp.asarray(0.0), 32, MEANS)
+    b = _sample_one(jnp.asarray(img), rect, size, jnp.asarray(1.0), 32, MEANS)
+    assert np.allclose(np.asarray(b), np.asarray(a)[:, ::-1, :])
+
+
+def test_jitter_identity_params():
+    rng = np.random.RandomState(3)
+    img = jnp.asarray(rng.randint(0, 256, (16, 16, 3)).astype(np.float32))
+    ident = jnp.asarray([0.0, 0.0, 1.0, 1.0, 0.0])
+    out = _jitter_one(img, ident)
+    assert np.abs(np.asarray(out) - np.asarray(img)).max() <= 1.0
+
+
+def test_jitter_brightness_contrast_exact():
+    img = jnp.ones((8, 8, 3)) * 100.0
+    out = _jitter_one(img, jnp.asarray([0.0, 20.0, 1.2, 1.0, 0.0]))
+    # order1: (x + 20) * 1.2 = 144 (grey pixel: sat/hue are no-ops)
+    assert np.allclose(np.asarray(out), 144.0, atol=1.0)
+    out2 = _jitter_one(img, jnp.asarray([0.9, 20.0, 1.2, 1.0, 0.0]))
+    # order2: contrast applied after sat/hue — same value for grey input
+    assert np.allclose(np.asarray(out2), 144.0, atol=1.0)
+
+
+def _shapes_batches(n=8, batch=4):
+    import os
+    import tempfile
+
+    from analytics_zoo_tpu.data import (SSDByteRecord, generate_shapes_records,
+                                        read_ssd_records)
+    from analytics_zoo_tpu.pipelines.ssd import RecordToFeature
+    from analytics_zoo_tpu.transform.vision import BytesToMat, RoiNormalize
+
+    with tempfile.TemporaryDirectory() as tmp:
+        paths = generate_shapes_records(os.path.join(tmp, "s"), n_images=n,
+                                        resolution=160, num_shards=1)
+        records = list(read_ssd_records(paths))
+    param = DeviceAugParam(resolution=96, canvas_size=192)
+    chain = (RecordToFeature() >> BytesToMat() >> RoiNormalize()
+             >> DeviceAugPrepare(param) >> DeviceAugBatch(batch, max_gt=8))
+    return list(chain(records)), param
+
+
+def test_device_aug_end_to_end():
+    batches, param = _shapes_batches()
+    assert batches, "no batches produced"
+    augment = make_device_augment(param)
+    out = augment(batches[0])
+    assert out["input"].shape == (4, 96, 96, 3)
+    assert np.isfinite(np.asarray(out["input"])).all()
+    assert "aug" not in out
+    t = batches[0]["target"]
+    assert t["bboxes"].shape[0] == 4
+    sel = t["mask"] > 0
+    if sel.any():
+        assert t["bboxes"][sel].min() >= 0.0
+        assert t["bboxes"][sel].max() <= 1.0
+    # pixel range sane: mean-subtracted uint8
+    x = np.asarray(out["input"])
+    assert x.min() >= -300 and x.max() <= 300
+
+
+def test_device_aug_pipeline_entry():
+    import os
+    import tempfile
+
+    from analytics_zoo_tpu.data import generate_shapes_records
+    from analytics_zoo_tpu.pipelines.ssd import (PreProcessParam,
+                                                 load_train_set_device)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        generate_shapes_records(os.path.join(tmp, "s"), n_images=8,
+                                resolution=160, num_shards=2)
+        pre = PreProcessParam(batch_size=4, resolution=96, max_gt=8,
+                              num_workers=2)
+        ds, augment = load_train_set_device(
+            os.path.join(tmp, "s-*.azr"), pre,
+            aug=DeviceAugParam(resolution=96, canvas_size=192))
+        batches = list(ds)
+        assert batches
+        out = augment(batches[0])
+        assert out["input"].shape == (4, 96, 96, 3)
