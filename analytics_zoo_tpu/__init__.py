@@ -20,4 +20,16 @@ Reference capability map: see SURVEY.md at the repo root.  Design notes:
 
 __version__ = "0.1.0"
 
+import os as _os
+
+if _os.environ.get("AZ_PLATFORM"):
+    # Explicit backend override (e.g. AZ_PLATFORM=cpu to debug locally or
+    # when the remote TPU relay is unreachable).  Must land before the
+    # first backend touch; plugins that force their own jax_platforms at
+    # registration (e.g. the axon relay) are overridden here too, which a
+    # plain JAX_PLATFORMS env var is not able to do.
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["AZ_PLATFORM"])
+
 from analytics_zoo_tpu.utils import engine  # noqa: F401

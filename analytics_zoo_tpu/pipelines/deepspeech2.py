@@ -46,6 +46,9 @@ class DS2Param:
     batch_size: int = 8
     n_mels: int = 13
     vocab: Optional[Sequence[str]] = None
+    # featurize (window → rFFT → mel) on device as one jitted batch
+    # program instead of per-segment host numpy (SURVEY.md §3.4 hot loop)
+    device_featurize: bool = True
 
     @property
     def utt_length(self) -> int:
@@ -94,6 +97,34 @@ class DeepSpeech2Pipeline:
             self._pad_to_batch = False
         self.vocab_decoder = (VocabDecoder(param.vocab)
                               if param.vocab else None)
+        self._dev_featurizer = None      # built lazily per segment size
+
+    def _featurize_device(self, segments: List[dict]) -> np.ndarray:
+        """Featurize in fixed ``batch_size`` device batches (last one
+        zero-padded) with host-parity frame masking — one static shape,
+        so exactly one XLA compile and bounded device memory regardless
+        of how many segments a call carries."""
+        from analytics_zoo_tpu.transform.audio import make_featurizer_device
+
+        seg_samples = self.segmenter.segment_size
+        if self._dev_featurizer is None:
+            self._dev_featurizer = make_featurizer_device(
+                seg_samples, utt_length=self.utt_length,
+                n_mels=self.param.n_mels)
+        bs = self.param.batch_size
+        out = np.zeros((len(segments), self.utt_length, self.param.n_mels),
+                       np.float32)
+        for start in range(0, len(segments), bs):
+            chunk = segments[start:start + bs]
+            batch = np.zeros((bs, seg_samples), np.float32)
+            n_valid = np.zeros((bs,), np.int32)
+            for i, s in enumerate(chunk):
+                x = s["samples"]
+                batch[i, :len(x)] = x
+                n_valid[i] = len(x)
+            out[start:start + len(chunk)] = np.asarray(
+                self._dev_featurizer(batch, n_valid))[:len(chunk)]
+        return out
 
     def transcribe_samples(self, utterances: Dict[str, np.ndarray]
                            ) -> Dict[str, str]:
@@ -101,12 +132,17 @@ class DeepSpeech2Pipeline:
         segments: List[dict] = []
         for audio_id, samples in utterances.items():
             segments.extend(self.segmenter.segment(samples, audio_id))
-        feats = np.stack([
-            featurize(s["samples"], utt_length=self.utt_length,
-                      n_mels=self.param.n_mels)
-            for s in segments
-        ]) if segments else np.zeros((0, self.utt_length,
-                                      self.param.n_mels), np.float32)
+        if not segments:
+            feats = np.zeros((0, self.utt_length, self.param.n_mels),
+                             np.float32)
+        elif self.param.device_featurize:
+            feats = np.asarray(self._featurize_device(segments))
+        else:
+            feats = np.stack([
+                featurize(s["samples"], utt_length=self.utt_length,
+                          n_mels=self.param.n_mels)
+                for s in segments
+            ])
 
         texts: List[str] = []
         for i in range(0, len(segments), self.param.batch_size):

@@ -9,6 +9,7 @@ from analytics_zoo_tpu.transform.audio.featurize import (
     dft_specgram,
     featurize,
     frame_signal,
+    make_featurizer_device,
     mel_features,
     mel_filterbank_matrix,
     transpose_flip,

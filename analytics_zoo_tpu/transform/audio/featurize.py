@@ -114,6 +114,53 @@ def featurize(samples: np.ndarray, utt_length: Optional[int] = None,
     return mel_features(spec, n_mels=n_mels, utt_length=utt_length)
 
 
+def make_featurizer_device(segment_samples: int,
+                           utt_length: Optional[int] = None,
+                           n_mels: int = N_MELS):
+    """Device-side batched featurization: the whole Windower → DFTSpecgram
+    → MelFilterBank chain as ONE jitted XLA program over a batch of
+    equal-length segments — the TPU-native replacement for the reference's
+    per-frame breeze FFT inside a DataFrame UDF (HOT LOOP, SURVEY.md §3.4).
+
+    Returns ``fn(samples (B, segment_samples), n_valid (B,)) →
+    (B, utt_length, n_mels)``.  ``n_valid`` is each row's true sample
+    count (rows are zero-padded to ``segment_samples``); frames beyond a
+    row's valid frame count are zeroed, matching the host path's
+    pad-with-zeros-after-log semantics (``MelFrequencyFilterBank``)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = max((segment_samples - WINDOW_SIZE) // WINDOW_STRIDE + 1, 0)
+    out_len = utt_length if utt_length is not None else n
+    idx = (np.arange(WINDOW_SIZE)[None, :]
+           + WINDOW_STRIDE * np.arange(n)[:, None])        # static gather map
+    window = np.hanning(WINDOW_SIZE).astype(np.float32)
+    fb = mel_filterbank_matrix(n_mels, WINDOW_SIZE)
+
+    idx_j = jnp.asarray(idx)
+    window_j = jnp.asarray(window)
+    fb_j = jnp.asarray(fb)
+
+    @jax.jit
+    def run(samples, n_valid):
+        samples = jnp.asarray(samples, jnp.float32)
+        frames = samples[:, idx_j] * window_j              # (B, n, W)
+        spec = jnp.abs(jnp.fft.rfft(frames, axis=-1))      # (B, n, W//2+1)
+        mel = jnp.log(jnp.maximum(spec @ fb_j, 1e-10))     # (B, n, n_mels)
+        frames_valid = jnp.maximum(
+            (jnp.asarray(n_valid, jnp.int32) - WINDOW_SIZE)
+            // WINDOW_STRIDE + 1, 0)                       # (B,)
+        mask = (jnp.arange(n)[None, :] < frames_valid[:, None])
+        mel = jnp.where(mask[..., None], mel, 0.0)
+        if n >= out_len:
+            mel = mel[:, :out_len]
+        else:
+            mel = jnp.pad(mel, ((0, 0), (0, out_len - n), (0, 0)))
+        return mel
+
+    return run
+
+
 @dataclasses.dataclass
 class TimeSegmenter:
     """Split long audio into ≤ ``segment_size``-sample chunks tagged with

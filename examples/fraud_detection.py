@@ -18,6 +18,8 @@ def main():
     p.add_argument("--epochs", type=int, default=10)
     p.add_argument("--threshold-from", type=int, default=20)
     p.add_argument("--threshold-to", type=int, default=40)
+    p.add_argument("--out", default=None,
+                   help="append a JSON accuracy report to this md file")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -51,6 +53,33 @@ def main():
     print(f"AUPRC = {res.auprc:.4f}")
     print(f"best vote threshold = {res.best_threshold}: "
           f"precision {res.precision:.4f}, recall {res.recall:.4f}")
+    if args.out:
+        import json
+        import sys
+        import time
+
+        report = {
+            "task": ("Kaggle creditcard.csv" if args.csv
+                     else "synthetic imbalanced (~0.2% positives)"),
+            "auprc": round(res.auprc, 4),
+            "best_threshold": res.best_threshold,
+            "precision": round(res.precision, 4),
+            "recall": round(res.recall, 4),
+            "bagging_models": args.models,
+        }
+        argv, skip = [], False
+        for a in sys.argv[1:]:
+            if skip:
+                skip = False
+            elif a == "--out":
+                skip = True
+            elif not a.startswith("--out="):
+                argv.append(a if " " not in a else repr(a))
+        cmd = ("python examples/fraud_detection.py " + " ".join(argv)).rstrip()
+        with open(args.out, "a") as f:
+            f.write(f"\n## Fraud detection ({time.strftime('%Y-%m-%d')})\n\n"
+                    f"Command: `{cmd}`\n\n```json\n"
+                    + json.dumps(report, indent=2) + "\n```\n")
 
 
 if __name__ == "__main__":

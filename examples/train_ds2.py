@@ -49,10 +49,14 @@ def main():
                    help="dir with mapping.txt + audio; synthetic if unset")
     p.add_argument("--epochs", type=int, default=10)
     p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--batches", type=int, default=8,
+                   help="synthetic training batches per epoch")
     p.add_argument("--hidden", type=int, default=64)
     p.add_argument("--rnn-layers", type=int, default=1)
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--checkpoint", default=None)
+    p.add_argument("--out", default=None,
+                   help="append a JSON accuracy report to this md file")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -84,16 +88,68 @@ def main():
             for i in range(0, len(x) - args.batch_size + 1, args.batch_size)
         ]
         utt_length = x.shape[1]
+        # hold out the last batch so the reported CER is on unseen data
+        heldout = batches[-1:] if len(batches) > 1 else batches
+        heldout_is_train = len(batches) == 1
+        batches = batches[:-1] if len(batches) > 1 else batches
     else:
         utt_length = 100
-        batches = synthetic_batches(8, args.batch_size,
+        batches = synthetic_batches(args.batches, args.batch_size,
                                     utt_length=utt_length, n_tokens=4)
+        heldout = synthetic_batches(2, args.batch_size, seed=123)
+        heldout_is_train = False
 
     model = make_ds2_model(hidden=args.hidden, n_rnn_layers=args.rnn_layers,
                            utt_length=utt_length)
     train_ds2(model, batches, epochs=args.epochs, lr=args.lr,
               checkpoint_path=args.checkpoint)
-    print("done")
+
+    # held-out eval: greedy-decode unseen synthetic utterances and score
+    # token-level edit distance (the ASREvaluator CER machinery)
+    import json
+    import time
+
+    import jax
+
+    from analytics_zoo_tpu.transform.audio import best_path_decode
+    from analytics_zoo_tpu.transform.audio.decoders import levenshtein
+
+    total_ed = total_len = exact = n_seq = 0
+    for hb in heldout:
+        log_probs = model.forward(hb["input"])
+        for i in range(hb["input"].shape[0]):
+            ref = "".join(ALPHABET[t] for t in hb["labels"][i]
+                          if t > 0)
+            hyp = best_path_decode(np.asarray(log_probs[i]))
+            total_ed += levenshtein(hyp, ref)
+            total_len += max(len(ref), 1)
+            exact += int(hyp == ref)
+            n_seq += 1
+    cer_field = ("train_set_cer" if heldout_is_train else "cer")
+    report = {
+        "task": ("LibriSpeech-style dir" if args.data_dir
+                 else "synthetic tone→token CTC (held-out)"),
+        cer_field: round(total_ed / max(total_len, 1), 4),
+        "exact_sequence_acc": round(exact / max(n_seq, 1), 4),
+        "sequences": n_seq,
+        "epochs": args.epochs,
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(report))
+    if args.out:
+        argv, skip = [], False
+        for a in sys.argv[1:]:
+            if skip:
+                skip = False
+            elif a == "--out":
+                skip = True
+            elif not a.startswith("--out="):
+                argv.append(a if " " not in a else repr(a))
+        cmd = ("python examples/train_ds2.py " + " ".join(argv))
+        with open(args.out, "a") as f:
+            f.write(f"\n## DeepSpeech2 CTC training ({time.strftime('%Y-%m-%d')})\n\n"
+                    f"Command: `{cmd.rstrip()}`\n\n```json\n"
+                    + json.dumps(report, indent=2) + "\n```\n")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,139 @@
+"""Scaling-efficiency sweep: SSD300 sharded train step over 1..N devices.
+
+BASELINE.json's third metric is "8→64-chip scaling efficiency ≥60%".  This
+harness measures weak scaling (fixed per-chip batch): for each device
+count it runs the same pjit'd train step the real pipeline uses —
+batches sharded over the mesh's ``data`` axis, parameters replicated,
+gradient mean compiled to an all-reduce — and reports
+``efficiency(n) = throughput(n) / (n · throughput(1))``.
+
+On real TPU slices the numbers are the metric.  Without enough real
+chips, pass ``--virtual`` to emulate the mesh with
+``--xla_force_host_platform_device_count`` on CPU: that validates the
+mechanism (sharding, collectives, program correctness at each mesh size)
+but NOT performance — virtual devices share the host's cores, so
+efficiency trends toward 1/n by construction and the output is labeled
+``"virtual": true``.
+
+Each device count runs in a fresh subprocess because XLA fixes the
+device count at backend init.
+
+Usage::
+
+    python tools/bench_scaling.py --devices 1 2 4 8 --virtual
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+_CHILD_FLAG = "--_child"
+
+
+def child(n: int, batch_per_chip: int, steps: int, res: int) -> None:
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.models import SSDVgg, build_priors, ssd300_config
+    from analytics_zoo_tpu.ops import MultiBoxLoss, MultiBoxLossParam
+    from analytics_zoo_tpu.parallel import (SGD, create_mesh,
+                                            create_train_state,
+                                            make_train_step, replicate,
+                                            shard_batch)
+
+    assert jax.device_count() == n, (jax.device_count(), n)
+    mesh = create_mesh()
+    model = Model(SSDVgg(num_classes=21, resolution=res))
+    model.build(0, jnp.zeros((1, res, res, 3), jnp.float32))
+    priors, variances = build_priors(ssd300_config())
+    criterion = MultiBoxLoss(priors, variances, MultiBoxLossParam())
+    optim = SGD(1e-3, momentum=0.9)
+    state = replicate(create_train_state(model, optim), mesh)
+    step = make_train_step(model.module, criterion, optim, mesh=mesh,
+                           compute_dtype="bf16")
+
+    import numpy as np
+
+    b = batch_per_chip * n
+    rng = np.random.RandomState(0)
+    batch = shard_batch({
+        "input": rng.rand(b, res, res, 3).astype(np.float32),
+        "target": {
+            "bboxes": np.tile(np.asarray([0.1, 0.1, 0.6, 0.6], np.float32),
+                              (b, 8, 1)),
+            "labels": rng.randint(1, 21, (b, 8)).astype(np.int32),
+            "mask": np.ones((b, 8), np.float32),
+        },
+    }, mesh)
+
+    state, m = step(state, batch, 1.0)                 # compile
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, batch, 1.0)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+    print(json.dumps({"n": n, "images_per_sec": b * steps / dt,
+                      "loss": float(m["loss"])}))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--devices", type=int, nargs="+", default=[1, 2, 4, 8])
+    p.add_argument("--batch-per-chip", type=int, default=8)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--res", type=int, default=300)
+    p.add_argument("--virtual", action="store_true",
+                   help="emulate each mesh size on CPU (mechanism check, "
+                        "NOT a performance measurement)")
+    p.add_argument(_CHILD_FLAG, type=int, default=None,
+                   dest="child_n", help=argparse.SUPPRESS)
+    args = p.parse_args()
+
+    if args.child_n is not None:
+        child(args.child_n, args.batch_per_chip, args.steps, args.res)
+        return 0
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    results = []
+    for n in args.devices:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (repo_root + os.pathsep + env["PYTHONPATH"]
+                             if env.get("PYTHONPATH") else repo_root)
+        if args.virtual:
+            env["PALLAS_AXON_POOL_IPS"] = ""
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                                + f" --xla_force_host_platform_device_count={n}"
+                                ).strip()
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), _CHILD_FLAG, str(n),
+             "--batch-per-chip", str(args.batch_per_chip),
+             "--steps", str(args.steps), "--res", str(args.res)],
+            env=env, capture_output=True, text=True, cwd=repo_root)
+        line = [l for l in out.stdout.splitlines() if l.startswith("{")]
+        if not line:
+            print(json.dumps({"n": n, "error": out.stderr[-500:]}),
+                  file=sys.stderr)
+            continue
+        results.append(json.loads(line[-1]))
+
+    if results:
+        base = results[0]["images_per_sec"] / results[0]["n"]
+        for r in results:
+            r["efficiency_vs_1chip"] = round(
+                r["images_per_sec"] / (r["n"] * base), 3)
+            r["virtual"] = bool(args.virtual)
+            print(json.dumps(r))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
