@@ -27,5 +27,6 @@ from analytics_zoo_tpu.ops.multibox_loss import (
 from analytics_zoo_tpu.ops.frcnn import FrcnnPostParam, frcnn_postprocess
 from analytics_zoo_tpu.ops.anchor import generate_base_anchors, shift_anchors
 from analytics_zoo_tpu.ops.proposal import ProposalParam, proposal
+from analytics_zoo_tpu.ops.roi_pool import roi_pool, roi_pool_batch
 
 __all__ = [k for k in dir() if not k.startswith("_")]

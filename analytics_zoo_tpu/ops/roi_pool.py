@@ -1,0 +1,101 @@
+"""ROI max-pooling (Caffe ``ROIPooling`` semantics — the layer the
+reference imports through ``common/caffe/RoiPoolingConverter.scala:28`` for
+Faster-RCNN graphs).
+
+Each ROI (pixel coords on the input image) is projected onto the feature
+map by ``spatial_scale``, partitioned into a fixed ``pooled_h × pooled_w``
+grid with Caffe's floor/ceil bin boundaries, and max-reduced per bin
+(empty bins → 0).  Output shape is static — ``(R, pooled_h, pooled_w, C)``
+— so the op composes with the static-shape :func:`~analytics_zoo_tpu.ops
+.proposal.proposal` output (padded ROIs + validity mask) under ``jit``.
+
+TPU-first formulation: instead of the reference's per-bin scalar loops,
+bins become boolean membership masks over the H and W axes and the pool is
+two masked ``max`` reductions (H then W) — batched over ROIs with ``vmap``,
+everything MXU/VPU-friendly with no dynamic shapes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("pooled_h", "pooled_w"))
+def roi_pool(feat: jax.Array, rois: jax.Array,
+             roi_mask: Optional[jax.Array] = None,
+             pooled_h: int = 7, pooled_w: int = 7,
+             spatial_scale: float = 1.0 / 16.0) -> jax.Array:
+    """feat (H, W, C) one image's feature map; rois (R, 4) x1y1x2y2 in
+    input-image pixels; roi_mask (R,) optional validity (invalid → zeros).
+
+    Returns (R, pooled_h, pooled_w, C).
+    """
+    H, W, C = feat.shape
+    rois = jnp.asarray(rois, jnp.float32)
+
+    # Caffe: round the scaled corners, then roi_{w,h} = end - start + 1
+    # clamped to >= 1; bin k spans [floor(k·bin), ceil((k+1)·bin)).
+    # C round() is half-away-from-zero — NOT jnp.round's half-to-even
+    # (x=2.5 must become 3, not 2, or every bin shifts by one cell).
+    def _round_c(x):
+        return jnp.trunc(x + jnp.sign(x) * 0.5)
+
+    start_w = _round_c(rois[:, 0] * spatial_scale)
+    start_h = _round_c(rois[:, 1] * spatial_scale)
+    end_w = _round_c(rois[:, 2] * spatial_scale)
+    end_h = _round_c(rois[:, 3] * spatial_scale)
+    roi_w = jnp.maximum(end_w - start_w + 1.0, 1.0)        # (R,)
+    roi_h = jnp.maximum(end_h - start_h + 1.0, 1.0)
+    bin_w = roi_w / pooled_w
+    bin_h = roi_h / pooled_h
+
+    ph = jnp.arange(pooled_h, dtype=jnp.float32)
+    pw = jnp.arange(pooled_w, dtype=jnp.float32)
+    # (R, PH) / (R, PW) integer bin bounds, clipped to the feature map
+    hstart = jnp.clip(jnp.floor(ph[None] * bin_h[:, None])
+                      + start_h[:, None], 0, H)
+    hend = jnp.clip(jnp.ceil((ph[None] + 1) * bin_h[:, None])
+                    + start_h[:, None], 0, H)
+    wstart = jnp.clip(jnp.floor(pw[None] * bin_w[:, None])
+                      + start_w[:, None], 0, W)
+    wend = jnp.clip(jnp.ceil((pw[None] + 1) * bin_w[:, None])
+                    + start_w[:, None], 0, W)
+
+    hidx = jnp.arange(H, dtype=jnp.float32)
+    widx = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(hs, he, ws, we):
+        mask_h = (hidx[None, :] >= hs[:, None]) & (hidx[None, :] < he[:, None])
+        mask_w = (widx[None, :] >= ws[:, None]) & (widx[None, :] < we[:, None])
+        neg = jnp.finfo(feat.dtype).min
+        # (PH, H, 1, 1) mask → max over H → (PH, W, C)
+        rows = jnp.max(jnp.where(mask_h[:, :, None, None], feat[None], neg),
+                       axis=1)
+        # (PW, W) mask over rows → (PH, PW, C)
+        out = jnp.max(jnp.where(mask_w[None, :, :, None], rows[:, None], neg),
+                      axis=2)
+        return jnp.where(out == neg, 0.0, out)             # empty bin → 0
+
+    out = jax.vmap(one_roi)(hstart, hend, wstart, wend)    # (R, PH, PW, C)
+    if roi_mask is not None:
+        out = out * roi_mask[:, None, None, None]
+    return out
+
+
+@partial(jax.jit, static_argnames=("pooled_h", "pooled_w"))
+def roi_pool_batch(feat: jax.Array, rois: jax.Array,
+                   roi_mask: Optional[jax.Array] = None,
+                   pooled_h: int = 7, pooled_w: int = 7,
+                   spatial_scale: float = 1.0 / 16.0) -> jax.Array:
+    """Batched: feat (B, H, W, C), rois (B, R, 4), mask (B, R) →
+    (B, R, pooled_h, pooled_w, C) — B images each with a fixed R ROIs (the
+    per-image ``post_nms_topn`` padding from :func:`proposal`)."""
+    fn = partial(roi_pool, pooled_h=pooled_h, pooled_w=pooled_w,
+                 spatial_scale=spatial_scale)
+    if roi_mask is None:
+        return jax.vmap(lambda f, r: fn(f, r))(feat, rois)
+    return jax.vmap(fn)(feat, rois, roi_mask)

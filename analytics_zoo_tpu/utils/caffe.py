@@ -477,12 +477,21 @@ def build_caffe_graph(netdef: Mapping[str, Any],
     from analytics_zoo_tpu.ops.priorbox import PriorBoxParam, prior_box
 
     specs = _layer_specs(netdef)
-    input_names = set(_aslist(netdef.get("input")))
+    # ordered; the data input is the first declared non-im_info input
+    input_names = [str(n) for n in _aslist(netdef.get("input"))]
+    input_names = ([n for n in input_names if n != "im_info"]
+                   + [n for n in input_names if n == "im_info"])
     registry: Dict[str, Callable] = dict(_CONVERTERS)
     if custom:
         registry.update(custom)
 
     skip_types = ("Input", "Data", "DummyData", "Silence", "Accuracy")
+
+    # im_info may be declared either as a legacy top-level `input:` or as
+    # a modern `layer { type: "Input" }` top — both get the synthetic
+    # constant (Input tops never materialize otherwise, being skip-typed)
+    has_im_info = "im_info" in input_names or any(
+        s.type == "Input" and "im_info" in s.tops for s in specs)
 
     # Static graph-output analysis.  A name is an output iff its FINAL
     # production is never consumed downstream; per-event tracking keeps
@@ -491,8 +500,10 @@ def build_caffe_graph(netdef: Mapping[str, Any],
     if entry is None:
         for s in specs:
             if s.type in skip_types[:3] and s.tops:
-                entry = s.tops[0]
-                break
+                tops = [t for t in s.tops if t != "im_info"]
+                if tops:
+                    entry = tops[0]
+                    break
     entry = entry or "data"
     last_producer: Dict[str, int] = {entry: -1}
     consumed_events = set()
@@ -523,6 +534,13 @@ def build_caffe_graph(netdef: Mapping[str, Any],
             tensors: Dict[str, Any] = {entry: x}
             layouts: Dict[str, str] = {
                 entry: "nhwc" if x.ndim == 4 else "flat"}
+            # Faster-RCNN deploy graphs declare a second input `im_info`
+            # (h, w, scale); for a fixed-shape deploy graph it is a
+            # constant derived from the data input's static shape.
+            if has_im_info and x.ndim == 4:
+                tensors["im_info"] = jnp.asarray(
+                    [[x.shape[1], x.shape[2], 1.0]], jnp.float32)
+                layouts["im_info"] = "flat"
 
             ctx = dict(nn=nn, jax=jax, jnp=jnp, L=L,
                        PriorBoxParam=PriorBoxParam, prior_box=prior_box,
@@ -842,6 +860,86 @@ def _unary(fn_name):
     return conv
 
 
+class _Rois(tuple):
+    """Marker: (rois (R, 5) [batch_idx,x1,y1,x2,y2], validity (R,))."""
+
+
+def _parse_param_str(pp: Mapping[str, Any]) -> Dict[str, Any]:
+    """Loose parse of a Python layer's ``param_str`` ("'feat_stride': 16")."""
+    import re
+
+    out: Dict[str, Any] = {}
+    for k, v in re.findall(r"['\"]?(\w+)['\"]?\s*:\s*([\d.]+)",
+                           str(pp.get("param_str", ""))):
+        out[k] = float(v) if "." in v else int(v)
+    return out
+
+
+def _python_proposal(module, spec, ins, louts, ctx):
+    """Faster-RCNN "Python" proposal layer → the Proposal op (reference
+    ``common/caffe/PythonConverter.scala:28``).  Bottoms: rpn class probs
+    (1, H, W, 2A nhwc), rpn bbox deltas (1, H, W, 4A), im_info."""
+    pp = spec.params.get("python_param", {})
+    layer = str(pp.get("layer", ""))
+    if "Proposal" not in layer and str(pp.get("module", "")) != "rpn.proposal_layer":
+        raise NotImplementedError(
+            f"Python layer {layer!r} has no converter (layer {spec.name!r})")
+    opts = _parse_param_str(pp)
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.ops.anchor import (generate_base_anchors,
+                                              shift_anchors)
+    from analytics_zoo_tpu.ops.proposal import ProposalParam, proposal
+
+    if len(ins) < 3:
+        raise ValueError(
+            f"Python proposal layer {spec.name!r} needs bottoms "
+            f"(scores, deltas, im_info), got {len(ins)}")
+    scores, deltas, im_info = ins[0], ins[1], ins[2]
+    scores = _to_nhwc(scores, louts[0], ctx)
+    deltas = _to_nhwc(deltas, louts[1], ctx)
+    feat_h, feat_w = deltas.shape[1], deltas.shape[2]
+    n_anchors = deltas.shape[3] // 4
+    # anchor base window is 16 px regardless of feat_stride
+    # (py-faster-rcnn's proposal layer hardcodes generate_anchors()'s
+    # base_size=16 default and only reads feat_stride from param_str)
+    anchors = shift_anchors(
+        generate_base_anchors(base_size=int(opts.get("base_size", 16))),
+        feat_h, feat_w, feat_stride=int(opts.get("feat_stride", 16)))
+    assert anchors.shape[0] == feat_h * feat_w * n_anchors, (
+        f"anchor count {anchors.shape[0]} != grid "
+        f"{feat_h}x{feat_w}x{n_anchors} (layer {spec.name!r})")
+    # NHWC flattening gives (H, W, A) order — the same order shift_anchors
+    # tiles, so scores/deltas/anchors line up row for row
+    fg = scores[0, :, :, n_anchors:].reshape(-1)
+    dl = deltas[0].reshape(-1, 4)
+    rois, mask = proposal(fg, dl, jnp.asarray(anchors),
+                          im_info[0, 0], im_info[0, 1], im_info[0, 2],
+                          ProposalParam())
+    rois5 = jnp.concatenate([jnp.zeros((rois.shape[0], 1), rois.dtype),
+                             rois], axis=1)
+    return _Rois((rois5, mask)), "rois"
+
+
+def _roi_pooling(module, spec, ins, louts, ctx):
+    """Caffe ROIPooling → :func:`ops.roi_pool` (reference
+    ``common/caffe/RoiPoolingConverter.scala:28``)."""
+    from analytics_zoo_tpu.ops.roi_pool import roi_pool
+
+    p = spec.params.get("roi_pooling_param", {})
+    feat = _to_nhwc(ins[0], louts[0], ctx)
+    rois_in = ins[1]
+    if isinstance(rois_in, _Rois):
+        rois5, mask = rois_in
+    else:
+        rois5, mask = rois_in, None
+    out = roi_pool(feat[0], rois5[:, 1:5], roi_mask=mask,
+                   pooled_h=int(p.get("pooled_h", 7)),
+                   pooled_w=int(p.get("pooled_w", 7)),
+                   spatial_scale=float(p.get("spatial_scale", 1.0 / 16.0)))
+    return out, "nhwc"                                     # (R, PH, PW, C)
+
+
 def _split(module, spec, ins, louts, ctx):
     return [ins[0]] * max(1, len(spec.tops)), louts[0]
 
@@ -885,4 +983,6 @@ _CONVERTERS: Dict[str, Callable] = {
     "BNLL": _unary("BNLL"),
     "Split": _split,
     "Slice": _slice,
+    "Python": _python_proposal,
+    "ROIPooling": _roi_pooling,
 }

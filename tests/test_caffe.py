@@ -415,3 +415,128 @@ class TestReviewRegressions:
             "n", "Normalize", [], [], [np.ones(4, np.float32)])])
         with pytest.raises(ValueError, match="V1"):
             save_caffemodel(str(tmp_path / "x.caffemodel"), net, v1=True)
+
+
+# ---------------------------------------------------------------------------
+# Faster-RCNN path: ROIPooling op + Python(Proposal)/ROIPooling converters
+# ---------------------------------------------------------------------------
+
+
+def _roi_pool_oracle(feat, rois, ph, pw, scale):
+    """Scalar-loop Caffe ROIPooling semantics (independent oracle)."""
+    H, W, C = feat.shape
+
+    def rnd(v):                      # C round(): half away from zero
+        return int(np.floor(v + 0.5)) if v >= 0 else int(np.ceil(v - 0.5))
+
+    out = np.zeros((len(rois), ph, pw, C), np.float32)
+    for r, (x1, y1, x2, y2) in enumerate(rois):
+        sw, sh = rnd(x1 * scale), rnd(y1 * scale)
+        ew, eh = rnd(x2 * scale), rnd(y2 * scale)
+        rw, rh = max(ew - sw + 1, 1), max(eh - sh + 1, 1)
+        bw, bh = rw / pw, rh / ph
+        for i in range(ph):
+            for j in range(pw):
+                hs = min(max(int(np.floor(i * bh)) + sh, 0), H)
+                he = min(max(int(np.ceil((i + 1) * bh)) + sh, 0), H)
+                ws = min(max(int(np.floor(j * bw)) + sw, 0), W)
+                we = min(max(int(np.ceil((j + 1) * bw)) + sw, 0), W)
+                if he > hs and we > ws:
+                    out[r, i, j] = feat[hs:he, ws:we].max(axis=(0, 1))
+    return out
+
+
+MINI_FRCNN = """
+name: "mini_frcnn"
+input: "data"
+input: "im_info"
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 8 kernel_size: 16 stride: 16 } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "rpn_cls_prob" type: "Convolution" bottom: "conv1"
+  top: "rpn_cls_prob" convolution_param { num_output: 18 kernel_size: 1 } }
+layer { name: "rpn_bbox_pred" type: "Convolution" bottom: "conv1"
+  top: "rpn_bbox_pred" convolution_param { num_output: 36 kernel_size: 1 } }
+layer { name: "proposal" type: "Python" bottom: "rpn_cls_prob"
+  bottom: "rpn_bbox_pred" bottom: "im_info" top: "rois"
+  python_param { module: "rpn.proposal_layer" layer: "ProposalLayer"
+    param_str: "'feat_stride': 16" } }
+layer { name: "roi_pool" type: "ROIPooling" bottom: "conv1" bottom: "rois"
+  top: "pool5" roi_pooling_param { pooled_h: 3 pooled_w: 3
+    spatial_scale: 0.0625 } }
+layer { name: "fc6" type: "InnerProduct" bottom: "pool5" top: "fc6"
+  inner_product_param { num_output: 10 } }
+layer { name: "cls_prob" type: "Softmax" bottom: "fc6" top: "cls_prob" }
+"""
+
+
+class TestRoiPool:
+    def test_matches_scalar_oracle(self):
+        from analytics_zoo_tpu.ops import roi_pool
+
+        rng = np.random.default_rng(7)
+        feat = rng.standard_normal((6, 8, 3)).astype(np.float32)
+        rois = np.asarray([
+            [0, 0, 127, 95],          # full map at scale 1/16
+            [16, 16, 63, 63],         # interior
+            [30, 10, 40, 80],         # thin roi -> some empty w-bins
+            [0, 0, 5, 5],             # smaller than one cell
+        ], np.float32)
+        got = np.asarray(roi_pool(feat, rois, pooled_h=3, pooled_w=3,
+                                  spatial_scale=1 / 16))
+        want = _roi_pool_oracle(feat, rois, 3, 3, 1 / 16)
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    def test_mask_zeroes_invalid(self):
+        from analytics_zoo_tpu.ops import roi_pool
+
+        feat = np.ones((4, 4, 2), np.float32)
+        rois = np.asarray([[0, 0, 63, 63], [0, 0, 63, 63]], np.float32)
+        out = np.asarray(roi_pool(feat, rois, np.asarray([1.0, 0.0]),
+                                  pooled_h=2, pooled_w=2))
+        assert out[0].max() == 1.0
+        assert np.all(out[1] == 0.0)
+
+    def test_batch(self):
+        from analytics_zoo_tpu.ops import roi_pool_batch
+
+        rng = np.random.default_rng(8)
+        feat = rng.standard_normal((2, 4, 4, 3)).astype(np.float32)
+        rois = np.tile(np.asarray([0, 0, 63, 63], np.float32), (2, 5, 1))
+        out = np.asarray(roi_pool_batch(feat, rois, pooled_h=2, pooled_w=2))
+        assert out.shape == (2, 5, 2, 2, 3)
+
+
+class TestMiniFrcnnGraph:
+    def test_frcnn_deploy_graph_runs(self):
+        import jax
+        import jax.numpy as jnp
+
+        netdef = parse_prototxt(MINI_FRCNN)
+        g = build_caffe_graph(netdef)
+        x = jnp.asarray(np.random.default_rng(9).standard_normal(
+            (1, 64, 64, 3)).astype(np.float32))
+        variables = g.init(jax.random.PRNGKey(0), x)
+        out = g.apply(variables, x)
+        # 300 padded proposals (ProposalParam.post_nms_topn) x 10 classes
+        assert out.shape == (300, 10)
+        assert np.all(np.isfinite(np.asarray(out)))
+        # caffemodel weight import round-trips through the built graph
+        names = {p for p in variables["params"]}
+        assert {"conv1", "rpn_cls_prob", "rpn_bbox_pred", "fc6"} <= names
+
+    def test_frcnn_input_layer_style(self):
+        # modern `layer { type: "Input" }` declarations instead of the
+        # legacy top-level `input:` fields
+        import jax
+        import jax.numpy as jnp
+
+        modern = (
+            'layer { name: "data" type: "Input" top: "data" }\n'
+            'layer { name: "im_info" type: "Input" top: "im_info" }\n'
+            + "\n".join(l for l in MINI_FRCNN.splitlines()
+                        if not l.startswith(("input:", "name:"))))
+        g = build_caffe_graph(parse_prototxt(modern))
+        x = jnp.zeros((1, 64, 64, 3), jnp.float32)
+        out = g.apply(g.init(jax.random.PRNGKey(0), x), x)
+        assert out.shape == (300, 10)
