@@ -44,5 +44,11 @@ from analytics_zoo_tpu.parallel.train import (
 )
 from analytics_zoo_tpu.parallel.summary import TrainSummary, ValidationSummary
 from analytics_zoo_tpu.parallel import checkpoint
+from analytics_zoo_tpu.parallel.elastic import (
+    DivergenceDetector,
+    FaultInjector,
+    TrainingDiverged,
+    run_resilient,
+)
 
 __all__ = [k for k in dir() if not k.startswith("_")]

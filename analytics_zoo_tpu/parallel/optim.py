@@ -17,7 +17,7 @@ functions of the step count and live inside the jitted update.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 import jax.numpy as jnp
 import optax
@@ -129,6 +129,23 @@ class OptimMethod:
     def on_validation(self, metrics: Dict[str, float]) -> None:
         if self.plateau is not None and self.plateau.monitor in metrics:
             self.plateau.update(metrics[self.plateau.monitor])
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Host-side state that must survive a checkpoint/resume (the
+        device-side opt_state lives in the TrainState; this is the rest —
+        Plateau's learned LR scale and patience counters)."""
+        if self.plateau is None:
+            return {}
+        return {"plateau": {"scale": self.plateau.scale,
+                            "best": self.plateau.best,
+                            "num_bad": self.plateau.num_bad}}
+
+    def load_state_dict(self, d: Dict[str, Any]) -> None:
+        p = d.get("plateau")
+        if p and self.plateau is not None:
+            self.plateau.scale = float(p["scale"])
+            self.plateau.best = p["best"]
+            self.plateau.num_bad = int(p["num_bad"])
 
 
 def _with_injected_lr(inner: Callable[[float], optax.GradientTransformation]):

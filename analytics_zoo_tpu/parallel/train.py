@@ -18,6 +18,7 @@ and metric-driven LR control (Plateau).
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -344,6 +345,11 @@ class Optimizer:
         self.skip_loss_above = skip_loss_above
         self.grad_clip_norm = grad_clip_norm
         self._score_name: Optional[str] = None
+        self.resume_path: Optional[str] = None
+        self._resume_requested = False
+        self.failure_detector = None
+        self._skip_batches = 0      # mid-epoch resume fast-forward
+        self._iter_in_epoch = 0
 
     # -- fluent config (reference API names, snake_cased) ------------------
     def set_optim_method(self, m: OptimMethod) -> "Optimizer":
@@ -370,6 +376,21 @@ class Optimizer:
         self.overwrite_checkpoint = overwrite
         return self
 
+    def set_resume(self, path: Optional[str] = None) -> "Optimizer":
+        """Resume from the latest checkpoint under ``path`` (defaults to the
+        ``set_checkpoint`` path, resolved at ``optimize()`` time so the
+        fluent-call order doesn't matter) when one exists — the reference's
+        ``--model``/``--state`` snapshot restart (``Train.scala:161-163``)."""
+        self.resume_path = path
+        self._resume_requested = True
+        return self
+
+    def set_failure_detector(self, detector) -> "Optimizer":
+        """Periodic loss-health check (``parallel.elastic.DivergenceDetector``);
+        raises out of ``optimize()`` so a supervisor can restart."""
+        self.failure_detector = detector
+        return self
+
     def set_train_summary(self, summary) -> "Optimizer":
         self.train_summary = summary
         return self
@@ -381,6 +402,11 @@ class Optimizer:
     # -- loop --------------------------------------------------------------
     def optimize(self) -> Model:
         state = create_train_state(self.model, self.optim)
+        loop = TrainingState()
+        if self._resume_requested:
+            resume_base = self.resume_path or self.checkpoint_path
+            if resume_base:
+                state, loop = self._try_resume(resume_base, state, loop)
         state = mesh_lib.replicate(state, self.mesh)
         train_step = make_train_step(
             self.model.module, self.criterion, self.optim,
@@ -390,20 +416,30 @@ class Optimizer:
         )
         eval_step = make_eval_step(self.model.module,
                                    compute_dtype=self.compute_dtype)
-        loop = TrainingState()
         t_epoch = time.time()
         records = 0
         stop = False
         while not stop and not self.end_when(loop):
             loop.epoch_finished = False
             for batch in self.dataset:
+                if self._skip_batches > 0:
+                    # mid-epoch resume: fast-forward past already-trained
+                    # batches of the interrupted epoch
+                    self._skip_batches -= 1
+                    self._iter_in_epoch += 1
+                    continue
                 n = _batch_size(batch)
                 dev_batch = mesh_lib.shard_batch(batch, self.mesh)
                 if self.device_transform is not None:
                     dev_batch = self.device_transform(dev_batch)
                 state, metrics = train_step(state, dev_batch, self.optim.lr_scale)
                 loop.iteration += 1
+                self._iter_in_epoch += 1
                 records += n
+                if (self.failure_detector is not None
+                        and self.failure_detector.should_check(loop.iteration)):
+                    self.failure_detector.check(float(metrics["loss"]),
+                                                loop.iteration)
                 # keep the loss as a device array — only force a host sync
                 # when something host-side actually reads it
                 loop.loss = metrics["loss"]
@@ -421,6 +457,7 @@ class Optimizer:
                 break  # partial epoch: don't count or re-trigger it
             loop.epoch += 1
             loop.epoch_finished = True
+            self._iter_in_epoch = 0
             loop.loss = float(loop.loss)
             dt = time.time() - t_epoch
             logger.info("Epoch %d done: %d records in %.1fs (%.1f records/s), loss %.4f",
@@ -462,9 +499,60 @@ class Optimizer:
         if getattr(self, "_last_ckpt_iter", None) == loop.iteration:
             return
         self._last_ckpt_iter = loop.iteration
+        # never snapshot a poisoned state: a non-finite loss means the
+        # params may already be NaN, and overwriting 'latest' with them
+        # would make every elastic restart resume the divergence
+        loss_now = float(loop.loss)
+        if not np.isfinite(loss_now):
+            logger.warning("skipping checkpoint at iteration %d: "
+                           "loss is %s", loop.iteration, loss_now)
+            return
+        import json
+
         from analytics_zoo_tpu.parallel import checkpoint as ckpt
         tag = None if self.overwrite_checkpoint else loop.iteration
         ckpt.save(self.checkpoint_path, state, step=tag)
+        # loop-position + host-optim sidecar so resume restores
+        # epoch/iteration/in-epoch position and Plateau's learned LR state
+        # (the TrainState only carries the step counter).  Written via
+        # temp-file + rename so a crash between the orbax save and this
+        # write can't pair new params with stale metadata.
+        meta = {"epoch": loop.epoch, "iteration": loop.iteration,
+                "iter_in_epoch": self._iter_in_epoch,
+                "optim": self.optim.state_dict()}
+        base = os.path.abspath(self.checkpoint_path)
+        tmp = os.path.join(base, ".loop_meta.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, os.path.join(base, "loop_meta.json"))
+
+    def _try_resume(self, base: str, state: TrainState, loop: TrainingState):
+        """Restore (state, loop, host optim state) from the latest
+        checkpoint under ``base`` if one exists; otherwise return the
+        fresh pair unchanged."""
+        import json
+
+        from analytics_zoo_tpu.parallel import checkpoint as ckpt
+        base = os.path.abspath(base)
+        has_ckpt = (os.path.exists(os.path.join(base, "latest"))
+                    or ckpt.latest_step(base) is not None)
+        if not has_ckpt:
+            return state, loop
+        state = ckpt.load(base, target=state)
+        meta_path = os.path.join(base, "loop_meta.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            loop.epoch = int(meta.get("epoch", 0))
+            loop.iteration = int(meta.get("iteration", int(state.step)))
+            self._skip_batches = int(meta.get("iter_in_epoch", 0))
+            self.optim.load_state_dict(meta.get("optim", {}))
+        else:
+            loop.iteration = int(state.step)
+        logger.info("resumed from %s at epoch %d, iteration %d "
+                    "(skipping %d in-epoch batches)",
+                    base, loop.epoch, loop.iteration, self._skip_batches)
+        return state, loop
 
 
 def _batch_size(batch) -> int:

@@ -1,0 +1,223 @@
+"""Elastic restart supervision + failure detection (parallel/elastic.py).
+
+The reference delegates recovery to Spark task retry (SURVEY.md §5
+"Failure detection"); here the supervisor itself is part of the framework,
+so it gets what the reference never had — direct tests: a mid-training
+crash must resume from the checkpoint (not restart from scratch), a
+non-finite loss streak must be detected, and the restart budget must be
+enforced.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from analytics_zoo_tpu.core.criterion import MSECriterion
+from analytics_zoo_tpu.core.module import Model
+from analytics_zoo_tpu.parallel import (
+    SGD,
+    DivergenceDetector,
+    FaultInjector,
+    Optimizer,
+    Trigger,
+    TrainingDiverged,
+    run_resilient,
+)
+
+
+def _dataset(n_batches=8, batch=8, dim=4, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(dim, 1).astype(np.float32)
+    batches = []
+    for _ in range(n_batches):
+        x = rng.randn(batch, dim).astype(np.float32)
+        batches.append({"input": x, "target": x @ w})
+    return batches
+
+
+def _model(dim=4):
+    m = Model(nn.Dense(1))
+    m.build(0, jnp.zeros((1, dim), jnp.float32))
+    return m
+
+
+class TestDivergenceDetector:
+    def test_finite_resets_streak(self):
+        d = DivergenceDetector(check_every=1, max_bad_checks=2)
+        d.check(1.0, 1)
+        d.check(float("nan"), 2)   # 1/2
+        d.check(1.0, 3)            # streak broken
+        d.check(float("nan"), 4)   # 1/2 again — no raise: reset worked
+        with pytest.raises(TrainingDiverged):
+            d.check(float("inf"), 5)   # 2/2 consecutive -> raises
+
+    def test_periodic(self):
+        d = DivergenceDetector(check_every=10)
+        assert d.should_check(10) and d.should_check(20)
+        assert not d.should_check(5)
+
+
+class TestResilientTraining:
+    def test_crash_resumes_from_checkpoint(self, tmp_path):
+        """Injected crash mid-epoch-2: the second attempt must resume from
+        the epoch-1 checkpoint instead of restarting at step 0."""
+        ckpt = str(tmp_path / "ckpt")
+        data = _dataset(n_batches=4)
+        attempts = []
+
+        def build():
+            injector = (FaultInjector(data, fail_at=6)   # during epoch 2
+                        if not attempts else data)
+            attempts.append(1)
+            opt = (Optimizer(_model(), injector, MSECriterion())
+                   .set_optim_method(SGD(0.05))
+                   .set_checkpoint(ckpt, Trigger.every_epoch())
+                   .set_end_when(Trigger.max_epoch(4)))
+            return opt
+
+        model = run_resilient(build, ckpt, max_restarts=2)
+        assert len(attempts) == 2
+        # trained to completion: 4 epochs x 4 batches = 16 iterations total,
+        # attempt 2 resumed at iteration 4 (epoch 1 checkpoint)
+        final = np.asarray(model.forward(data[0]["input"]))
+        loss0 = float(np.mean((data[0]["target"]) ** 2))
+        loss1 = float(np.mean((final - data[0]["target"]) ** 2))
+        assert loss1 < loss0
+
+    def test_resume_restores_loop_position(self, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        data = _dataset(n_batches=3)
+        (Optimizer(_model(), data, MSECriterion())
+         .set_optim_method(SGD(0.05))
+         .set_checkpoint(ckpt, Trigger.every_epoch())
+         .set_end_when(Trigger.max_epoch(2))
+         .optimize())
+        # fresh optimizer resuming: end_when(max_epoch(2)) already met ->
+        # optimize() returns without running any extra iterations
+        opt2 = (Optimizer(_model(), data, MSECriterion())
+                .set_optim_method(SGD(0.05))
+                .set_checkpoint(ckpt, Trigger.every_epoch())
+                .set_resume(ckpt)
+                .set_end_when(Trigger.max_epoch(2)))
+        opt2.optimize()
+        assert int(opt2._last_state.step) == 6   # 2 epochs x 3 batches, no more
+
+    def test_gives_up_after_budget(self, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        data = _dataset(n_batches=2)
+
+        def build():
+            # fails every attempt at the first batch
+            opt = (Optimizer(_model(),
+                             FaultInjector(data, fail_at=0), MSECriterion())
+                   .set_optim_method(SGD(0.05))
+                   .set_end_when(Trigger.max_epoch(1)))
+            return opt
+
+        with pytest.raises(RuntimeError, match="injected fault"):
+            run_resilient(build, ckpt, max_restarts=2)
+
+    def test_non_retryable_propagates_immediately(self, tmp_path):
+        calls = []
+
+        def build():
+            calls.append(1)
+            raise ValueError("config bug")
+
+        with pytest.raises(ValueError):
+            run_resilient(build, str(tmp_path / "c"), max_restarts=5)
+        assert len(calls) == 1
+
+    def test_divergence_detector_in_loop(self, tmp_path):
+        """A criterion that goes NaN mid-training trips the detector."""
+        data = _dataset(n_batches=4)
+
+        class PoisonCriterion(MSECriterion):
+            def __call__(self, output, batch):
+                loss = super().__call__(output, batch)
+                return loss + jnp.log(-jnp.ones(()))   # NaN every step
+
+        opt = (Optimizer(_model(), data, PoisonCriterion())
+               .set_optim_method(SGD(0.05))
+               .set_failure_detector(
+                   DivergenceDetector(check_every=1, max_bad_checks=2))
+               .set_end_when(Trigger.max_epoch(2)))
+        with pytest.raises(TrainingDiverged):
+            opt.optimize()
+
+
+class TestReviewRegressions:
+    def test_midepoch_resume_fast_forwards(self, tmp_path):
+        """Crash after a mid-epoch (several_iteration) checkpoint: resume
+        must skip the already-trained batches of the interrupted epoch —
+        total optimizer steps stay exactly epochs x batches."""
+        ckpt = str(tmp_path / "ckpt")
+        data = _dataset(n_batches=4)
+        attempts = []
+
+        def build():
+            ds = FaultInjector(data, fail_at=5) if not attempts else data
+            attempts.append(1)
+            return (Optimizer(_model(), ds, MSECriterion())
+                    .set_optim_method(SGD(0.05))
+                    .set_checkpoint(ckpt, Trigger.several_iteration(3))
+                    .set_end_when(Trigger.max_epoch(2)))
+
+        run_resilient(build, ckpt, max_restarts=2)
+        assert len(attempts) == 2
+        # without fast-forward the replayed epoch-1 prefix would push the
+        # final step count past 8
+        from analytics_zoo_tpu.parallel import checkpoint as cp
+        import jax.numpy as jnp2  # noqa: F401
+        meta_iters = 2 * 4
+        # the second attempt's final state is in the optimizer; re-load the
+        # last checkpoint to inspect the step counter
+        state = cp.load(ckpt)
+        assert int(np.asarray(state["step"])) <= meta_iters
+
+    def test_resume_before_checkpoint_order_independent(self, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        data = _dataset(n_batches=2)
+        (Optimizer(_model(), data, MSECriterion())
+         .set_optim_method(SGD(0.05))
+         .set_checkpoint(ckpt, Trigger.every_epoch())
+         .set_end_when(Trigger.max_epoch(1))
+         .optimize())
+        # set_resume() called BEFORE set_checkpoint must still resolve
+        opt = (Optimizer(_model(), data, MSECriterion())
+               .set_optim_method(SGD(0.05))
+               .set_resume()
+               .set_checkpoint(ckpt, Trigger.every_epoch())
+               .set_end_when(Trigger.max_epoch(1)))
+        opt.optimize()
+        assert int(opt._last_state.step) == 2   # resumed, ran 0 extra epochs
+
+    def test_optim_state_roundtrip(self):
+        from analytics_zoo_tpu.parallel.optim import Plateau
+        m = SGD(0.1, plateau=Plateau(patience=0))
+        m.on_validation({"score": 1.0})
+        m.on_validation({"score": 0.5})   # worse -> scale halves
+        assert m.lr_scale == 0.5
+        d = m.state_dict()
+        m2 = SGD(0.1, plateau=Plateau(patience=0))
+        m2.load_state_dict(d)
+        assert m2.lr_scale == 0.5
+        assert m2.plateau.best == 1.0
+
+    def test_no_checkpoint_when_loss_nonfinite(self, tmp_path):
+        import os
+        ckpt = str(tmp_path / "ckpt")
+        data = _dataset(n_batches=2)
+
+        class PoisonCriterion(MSECriterion):
+            def __call__(self, output, batch):
+                return super().__call__(output, batch) + jnp.log(-jnp.ones(()))
+
+        opt = (Optimizer(_model(), data, PoisonCriterion())
+               .set_optim_method(SGD(0.05))
+               .set_checkpoint(ckpt, Trigger.every_epoch())
+               .set_end_when(Trigger.max_epoch(1)))
+        opt.optimize()
+        assert not os.path.exists(os.path.join(ckpt, "latest"))
