@@ -129,22 +129,37 @@ class WideAndDeep(nn.Module):
 
 
 class NeuralCF(nn.Module):
-    """(user_ids (B,), item_ids (B,)) → (B, n_classes) log-probs."""
+    """(user_ids (B,), item_ids (B,)) → (B, n_classes) log-probs.
+
+    The reference notebook's model is embeddings → JoinTable → MLP →
+    LogSoftMax (``recommender-explicit-feedback.ipynb``); ``include_mf``
+    adds the NCF paper's GMF branch (a separate embedding pair fused by
+    elementwise product) alongside the MLP tower — concat-MLPs alone are
+    notoriously slow to recover the multiplicative user·item structure
+    that drives real rating data."""
 
     n_users: int = 1000
     n_items: int = 1000
     embedding_dim: int = 20
+    mf_embedding_dim: int = 8
     hidden: Sequence[int] = (40, 20)
     n_classes: int = 5
+    include_mf: bool = True
 
     @nn.compact
     def __call__(self, users, items):
-        u = nn.Embed(self.n_users, self.embedding_dim, name="user_embed")(
-            users.astype(jnp.int32))
-        v = nn.Embed(self.n_items, self.embedding_dim, name="item_embed")(
-            items.astype(jnp.int32))
+        users = users.astype(jnp.int32)
+        items = items.astype(jnp.int32)
+        u = nn.Embed(self.n_users, self.embedding_dim, name="user_embed")(users)
+        v = nn.Embed(self.n_items, self.embedding_dim, name="item_embed")(items)
         h = jnp.concatenate([u, v], axis=-1)
         for i, width in enumerate(self.hidden):
             h = nn.relu(nn.Dense(width, name=f"fc{i}")(h))
+        if self.include_mf:
+            mu = nn.Embed(self.n_users, self.mf_embedding_dim,
+                          name="mf_user_embed")(users)
+            mv = nn.Embed(self.n_items, self.mf_embedding_dim,
+                          name="mf_item_embed")(items)
+            h = jnp.concatenate([mu * mv, h], axis=-1)
         h = nn.Dense(self.n_classes, name="out")(h)
         return jax.nn.log_softmax(h, axis=-1)

@@ -54,10 +54,6 @@ def main():
     print(f"best vote threshold = {res.best_threshold}: "
           f"precision {res.precision:.4f}, recall {res.recall:.4f}")
     if args.out:
-        import json
-        import sys
-        import time
-
         report = {
             "task": ("Kaggle creditcard.csv" if args.csv
                      else "synthetic imbalanced (~0.2% positives)"),
@@ -67,19 +63,9 @@ def main():
             "recall": round(res.recall, 4),
             "bagging_models": args.models,
         }
-        argv, skip = [], False
-        for a in sys.argv[1:]:
-            if skip:
-                skip = False
-            elif a == "--out":
-                skip = True
-            elif not a.startswith("--out="):
-                argv.append(a if " " not in a else repr(a))
-        cmd = ("python examples/fraud_detection.py " + " ".join(argv)).rstrip()
-        with open(args.out, "a") as f:
-            f.write(f"\n## Fraud detection ({time.strftime('%Y-%m-%d')})\n\n"
-                    f"Command: `{cmd}`\n\n```json\n"
-                    + json.dumps(report, indent=2) + "\n```\n")
+        from analytics_zoo_tpu.utils.report import append_report
+        append_report(args.out, "Fraud detection",
+                      "examples/fraud_detection.py", report)
 
 
 if __name__ == "__main__":

@@ -22,6 +22,8 @@ def main():
     p.add_argument("--topk", type=int, default=5)
     p.add_argument("--model", choices=("ncf", "wide_and_deep"),
                    default="ncf")
+    p.add_argument("--out", default=None,
+                   help="append a JSON accuracy report to this md file")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -73,6 +75,32 @@ def main():
                      [MAE(), Loss(crit)])
      .set_end_when(Trigger.max_epoch(args.epochs))
      .optimize())
+
+    # held-out MAE on predicted star class (notebook's MAE validation) via
+    # the framework's monoid-reduce validator
+    import json
+
+    import jax
+
+    from analytics_zoo_tpu.parallel import validate
+
+    res = validate(model.module, model.variables,
+                   batches(split, args.ratings, False), [MAE()])
+    if not res:
+        sys.exit("held-out set produced no batches — lower --batch-size")
+    report = {
+        "task": "synthetic MovieLens-style explicit feedback (held-out)",
+        "model": args.model,
+        "mae_stars": round(res[0].result(), 4),
+        "ratings": args.ratings,
+        "epochs": args.epochs,
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(report))
+    if args.out:
+        from analytics_zoo_tpu.utils.report import append_report
+        append_report(args.out, f"Recommender ({args.model})",
+                      "examples/recommender.py", report)
 
     # top-K recommendation for one user (notebook's predict_class + groupBy)
     uid = 0

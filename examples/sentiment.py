@@ -22,6 +22,8 @@ def main():
     p.add_argument("--embedding-dim", type=int, default=64)
     p.add_argument("--hidden", type=int, default=64)
     p.add_argument("--samples", type=int, default=4096)
+    p.add_argument("--out", default=None,
+                   help="append a JSON accuracy report to this md file")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -71,6 +73,31 @@ def main():
      .set_validation(Trigger.every_epoch(), val, [BinaryAccuracy()])
      .set_end_when(Trigger.max_epoch(args.epochs))
      .optimize())
+
+    # held-out accuracy (the notebook's final confusion-matrix cell) via
+    # the framework's monoid-reduce validator
+    import json
+
+    import jax
+
+    from analytics_zoo_tpu.parallel import validate
+
+    res = validate(model.module, model.variables, val, [BinaryAccuracy()])
+    if not res:
+        sys.exit("held-out set produced no batches — lower --batch-size")
+    report = {
+        "task": "synthetic IMDB-style sentiment (held-out)",
+        "head": args.head,
+        "accuracy": round(res[0].result(), 4),
+        "samples": args.samples,
+        "epochs": args.epochs,
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(report))
+    if args.out:
+        from analytics_zoo_tpu.utils.report import append_report
+        append_report(args.out, f"Sentiment ({args.head} head)",
+                      "examples/sentiment.py", report)
 
 
 if __name__ == "__main__":

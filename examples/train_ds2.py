@@ -107,7 +107,6 @@ def main():
     # held-out eval: greedy-decode unseen synthetic utterances and score
     # token-level edit distance (the ASREvaluator CER machinery)
     import json
-    import time
 
     import jax
 
@@ -137,19 +136,9 @@ def main():
     }
     print(json.dumps(report))
     if args.out:
-        argv, skip = [], False
-        for a in sys.argv[1:]:
-            if skip:
-                skip = False
-            elif a == "--out":
-                skip = True
-            elif not a.startswith("--out="):
-                argv.append(a if " " not in a else repr(a))
-        cmd = ("python examples/train_ds2.py " + " ".join(argv))
-        with open(args.out, "a") as f:
-            f.write(f"\n## DeepSpeech2 CTC training ({time.strftime('%Y-%m-%d')})\n\n"
-                    f"Command: `{cmd.rstrip()}`\n\n```json\n"
-                    + json.dumps(report, indent=2) + "\n```\n")
+        from analytics_zoo_tpu.utils.report import append_report
+        append_report(args.out, "DeepSpeech2 CTC training",
+                      "examples/train_ds2.py", report)
 
 
 if __name__ == "__main__":
