@@ -44,6 +44,11 @@ from analytics_zoo_tpu.parallel.train import (
 )
 from analytics_zoo_tpu.parallel.summary import TrainSummary, ValidationSummary
 from analytics_zoo_tpu.parallel import checkpoint
+from analytics_zoo_tpu.parallel.tensor import (
+    default_tp_rules,
+    shard_tree,
+    sharded_param_count,
+)
 from analytics_zoo_tpu.parallel.elastic import (
     DivergenceDetector,
     FaultInjector,

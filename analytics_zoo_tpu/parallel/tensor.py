@@ -1,0 +1,102 @@
+"""Tensor (model) parallelism via GSPMD sharding rules.
+
+The reference scales one way only — data-parallel replicas with a
+block-manager AllReduce (SURVEY.md §2.7 "Optimizer") — because BigDL
+models must fit one executor.  On TPU the idiomatic generalization is not
+explicit collectives but *sharding annotations*: place weight shards on a
+``model`` mesh axis with ``NamedSharding`` and let XLA's SPMD partitioner
+split the matmuls/convs and insert the all-gathers/reduce-scatters over
+ICI (the scaling-book recipe: pick a mesh, annotate, let XLA do the
+rest).  Nothing in the train step changes — the same jitted program runs
+1D data-parallel or 2D data×model depending only on where the arrays
+live.
+
+Rules are matched against the '/'-joined pytree path, so they apply
+equally to ``params`` and to optimizer slots that mirror params (optax's
+``mu``/``nu``/``trace`` carry the same sub-paths).  A dimension that
+doesn't divide the mesh axis falls back to replicated — sharding is an
+optimization, never a correctness requirement.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from analytics_zoo_tpu.parallel.mesh import MODEL_AXIS
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+# rule: (path_regex, spec_fn(shape) -> PartitionSpec-axis-tuple)
+Rule = Tuple[str, Callable[[Tuple[int, ...]], Sequence[Optional[str]]]]
+
+
+def _last_dim(axis: str):
+    """Shard the trailing (output-feature) dim — Dense kernels (in, out),
+    Conv kernels (kh, kw, cin, cout), Embed tables (vocab, features)."""
+    def spec(shape):
+        return [None] * (len(shape) - 1) + [axis]
+    return spec
+
+
+def default_tp_rules(axis: str = MODEL_AXIS) -> List[Rule]:
+    """Megatron-style column sharding of every learnable matrix's output
+    features; biases/scales stay replicated (1-D, tiny)."""
+    return [
+        (r"(^|.*/)(kernel|embedding)$", _last_dim(axis)),
+    ]
+
+
+def partition_spec(path: str, shape: Tuple[int, ...], mesh: Mesh,
+                   rules: Sequence[Rule]) -> P:
+    """Resolve the first matching rule into a PartitionSpec, degrading to
+    replicated when the sharded dim doesn't divide the mesh axis."""
+    for pattern, spec_fn in rules:
+        if re.match(pattern, path):
+            axes = list(spec_fn(shape))
+            for i, ax in enumerate(axes):
+                if ax is not None and (ax not in mesh.shape
+                                       or shape[i] % mesh.shape[ax] != 0):
+                    logger.debug("tp: %s dim %d (%d) not divisible by "
+                                 "axis %r — replicating", path, i, shape[i], ax)
+                    axes[i] = None
+            return P(*axes)
+    return P()
+
+
+def shard_tree(tree: Any, mesh: Mesh,
+               rules: Optional[Sequence[Rule]] = None) -> Any:
+    """device_put every leaf with its rule-resolved NamedSharding.  Works
+    on a params dict or a whole TrainState (optimizer slots that mirror
+    params pick up the same specs through their matching sub-paths)."""
+    rules = default_tp_rules() if rules is None else rules
+
+    def put(path_entries, leaf):
+        path = "/".join(str(getattr(e, "key", getattr(e, "name", e)))
+                        for e in path_entries)
+        arr = np.asarray(leaf) if not isinstance(leaf, jax.Array) else leaf
+        spec = (partition_spec(path, arr.shape, mesh, rules)
+                if getattr(arr, "ndim", 0) > 0 else P())
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(put, tree)
+
+
+def sharded_param_count(tree: Any) -> int:
+    """Number of array LEAVES whose sharding actually splits data across
+    more than one device (diagnostic for tests/logging).  On a full
+    TrainState this counts optimizer-slot mirrors too (momentum/mu/nu
+    carry the same sharding as their parameter), so it is a leaf count,
+    not a distinct-parameter count — pass just the params subtree for
+    the latter."""
+    n = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        sh = getattr(leaf, "sharding", None)
+        if sh is not None and not sh.is_fully_replicated:
+            n += 1
+    return n

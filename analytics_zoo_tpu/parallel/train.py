@@ -323,7 +323,8 @@ class Optimizer:
     def __init__(self, model: Model, dataset, criterion, mesh=None,
                  skip_loss_above: Optional[float] = None,
                  grad_clip_norm: Optional[float] = None,
-                 compute_dtype=None, device_transform=None):
+                 compute_dtype=None, device_transform=None,
+                 param_rules=None):
         self.model = model
         self.dataset = dataset
         self.criterion = criterion
@@ -344,6 +345,9 @@ class Optimizer:
         self.val_summary = None
         self.skip_loss_above = skip_loss_above
         self.grad_clip_norm = grad_clip_norm
+        # tensor-parallel sharding rules (parallel.tensor); None = pure
+        # data-parallel replication
+        self.param_rules = param_rules
         self._score_name: Optional[str] = None
         self.resume_path: Optional[str] = None
         self._resume_requested = False
@@ -407,7 +411,11 @@ class Optimizer:
             resume_base = self.resume_path or self.checkpoint_path
             if resume_base:
                 state, loop = self._try_resume(resume_base, state, loop)
-        state = mesh_lib.replicate(state, self.mesh)
+        if self.param_rules is not None:
+            from analytics_zoo_tpu.parallel import tensor as tp
+            state = tp.shard_tree(state, self.mesh, self.param_rules)
+        else:
+            state = mesh_lib.replicate(state, self.mesh)
         train_step = make_train_step(
             self.model.module, self.criterion, self.optim,
             mesh=self.mesh, skip_loss_above=self.skip_loss_above,

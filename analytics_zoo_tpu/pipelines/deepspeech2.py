@@ -207,10 +207,13 @@ def make_ds2_model(hidden: int = 1024, n_rnn_layers: int = 3,
 
 
 def train_ds2(model: Model, dataset, epochs: int = 10, lr: float = 3e-4,
-              mesh=None, checkpoint_path: Optional[str] = None):
+              mesh=None, checkpoint_path: Optional[str] = None,
+              param_rules=None):
     """CTC training for DS2 — capability the reference lacks (its DS2 is
     inference-only; SURVEY.md §2.3).  ``dataset`` yields batches
     ``{"input": (B,T,n_mels), "labels": (B,L) int32, "label_mask": (B,L)}``.
+    ``param_rules`` enables tensor-parallel weight sharding
+    (``parallel.tensor.default_tp_rules``) on a data×model mesh.
     """
     from analytics_zoo_tpu.core.criterion import CTCCriterion
     from analytics_zoo_tpu.parallel import Adam, Optimizer, Trigger, create_mesh
@@ -222,7 +225,8 @@ def train_ds2(model: Model, dataset, epochs: int = 10, lr: float = 3e-4,
         return ctc(log_probs, batch["labels"],
                    label_mask=batch.get("label_mask"))
 
-    opt = (Optimizer(model, dataset, criterion, mesh=mesh)
+    opt = (Optimizer(model, dataset, criterion, mesh=mesh,
+                     param_rules=param_rules)
            .set_optim_method(Adam(lr))
            .set_end_when(Trigger.max_epoch(epochs)))
     if checkpoint_path:
