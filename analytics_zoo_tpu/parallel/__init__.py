@@ -44,6 +44,11 @@ from analytics_zoo_tpu.parallel.train import (
 )
 from analytics_zoo_tpu.parallel.summary import TrainSummary, ValidationSummary
 from analytics_zoo_tpu.parallel import checkpoint
+from analytics_zoo_tpu.parallel.pipeline import (
+    pipeline_forward,
+    split_microbatches,
+    stack_stage_params,
+)
 from analytics_zoo_tpu.parallel.tensor import (
     default_tp_rules,
     shard_tree,

@@ -1,0 +1,118 @@
+"""Pipeline (stage) parallelism — GPipe-style microbatched execution of a
+stack of identical blocks, one stage per device along a ``pipe`` mesh axis.
+
+Net-new capability (the reference's only parallelism is data-parallel
+replicas, SURVEY.md §2.7), completing the framework's mesh-axis story:
+``data`` × ``model`` × ``sequence`` × ``pipe``.
+
+TPU-idiomatic formulation (the praxis/T5X "pipelined scan" pattern):
+stage parameters are STACKED on a leading (L, ...) axis and sharded over
+``pipe`` so each device holds one stage; a ``lax.scan`` over
+``M + L - 1`` ticks runs inside ``shard_map`` — every tick each device
+applies its stage to its current activation, then hands the result one
+hop right via ``ppermute`` (which rides ICI).  Stage 0 injects a fresh
+microbatch per tick; the last stage's outputs are collected with a
+static one-hot scatter so shapes stay fixed for XLA.  Being pure
+``scan``+``ppermute``, the schedule is differentiable — ``jax.grad``
+through :func:`pipeline_forward` yields the reverse (backward-pipelined)
+schedule automatically, so the same train-step factories work unchanged.
+
+The pipeline bubble is the usual (L-1)/(M+L-1) fraction: amortize with
+more microbatches M.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from analytics_zoo_tpu.parallel.sequence import _shard_map
+
+PIPE_AXIS = "pipe"
+
+
+def stack_stage_params(params_list) -> Any:
+    """[per-stage params pytree] → one pytree with leading (L, ...) axis
+    (stages must share a structure — a stack of identical blocks)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def pipeline_forward(apply_block: Callable[[Any, jax.Array], jax.Array],
+                     stacked_params: Any,
+                     microbatches: jax.Array,
+                     mesh: Mesh,
+                     axis_name: str = PIPE_AXIS,
+                     batch_axis: Optional[str] = None) -> jax.Array:
+    """Run ``y_m = block_{L-1}(... block_0(x_m))`` for every microbatch.
+
+    ``apply_block(stage_params, x) → y`` must preserve x's shape (uniform
+    inter-stage activations — the standard homogeneous-pipeline contract).
+    ``stacked_params``: leading dim L == size of ``axis_name``.
+    ``microbatches``: (M, B, ...) — M microbatches, replicated over the
+    pipe axis (or sharded over ``batch_axis`` on dim 1 for 2-D meshes).
+
+    Returns (M, B, ...) outputs, replicated like the input.
+    """
+    L = mesh.shape[axis_name]
+    n_stages = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_stages != L:
+        # shard_map would happily split a multiple-of-L stack and the [0]
+        # squeeze below would then silently drop every stage but the first
+        # on each device
+        raise ValueError(
+            f"stacked_params has {n_stages} stages but the {axis_name!r} "
+            f"axis has {L} devices — one stage per device required")
+    M = microbatches.shape[0]
+    T = M + L - 1
+
+    stage_spec = jax.tree_util.tree_map(
+        lambda _: P(axis_name), stacked_params)
+    mb_spec = P(None, batch_axis)
+
+    def local(params_l, mbs):
+        # params_l: (1, ...) — this device's stage;  mbs: (M, B, ...)
+        params = jax.tree_util.tree_map(lambda p: p[0], params_l)
+        stage = jax.lax.axis_index(axis_name)
+        n = jax.lax.psum(1, axis_name)
+        buf = jnp.zeros_like(mbs[0])               # current activation
+        outs = jnp.zeros_like(mbs)                 # last stage's collection
+
+        def tick(carry, t):
+            buf, outs = carry
+            # stage 0 takes microbatch t (clamped; junk ticks discarded)
+            inject = mbs[jnp.clip(t, 0, M - 1)]
+            x = jnp.where(stage == 0, inject, buf)
+            y = apply_block(params, x)
+            # collect on the last stage at ticks t in [L-1, T)
+            m_idx = t - (n - 1)
+            keep = (stage == n - 1) & (m_idx >= 0)
+            onehot = (jnp.arange(M) == jnp.clip(m_idx, 0, M - 1)) & keep
+            outs = jnp.where(
+                onehot.reshape((M,) + (1,) * (outs.ndim - 1)), y[None], outs)
+            # hand y one hop right (last stage's send is dropped)
+            nxt = jax.lax.ppermute(y, axis_name,
+                                   [(i, i + 1) for i in range(n - 1)])
+            return (nxt, outs), None
+
+        (_, outs), _ = jax.lax.scan(tick, (buf, outs), jnp.arange(T))
+        # only the last stage collected real results; zero-mask everyone
+        # else and psum to broadcast them pipe-wide (out_specs replicate
+        # over the pipe axis)
+        contrib = jnp.where(stage == n - 1, outs, jnp.zeros_like(outs))
+        return jax.lax.psum(contrib, axis_name)
+
+    fn = _shard_map(local, mesh,
+                    in_specs=(stage_spec, mb_spec),
+                    out_specs=mb_spec)
+    return fn(stacked_params, microbatches)
+
+
+def split_microbatches(x: jax.Array, n_micro: int) -> jax.Array:
+    """(B, ...) → (M, B/M, ...) microbatches for the pipeline schedule."""
+    B = x.shape[0]
+    if B % n_micro:
+        raise ValueError(f"batch {B} not divisible by {n_micro} microbatches")
+    return x.reshape((n_micro, B // n_micro) + x.shape[1:])

@@ -1,0 +1,147 @@
+"""Pipeline parallelism (parallel/pipeline.py) on the virtual 8-device
+mesh: the GPipe microbatch schedule must match running the stage stack
+sequentially, forward AND backward (autodiff through the scan+ppermute
+schedule), including on a 2-D (pipe × data) mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from analytics_zoo_tpu.parallel.mesh import create_mesh
+from analytics_zoo_tpu.parallel.pipeline import (
+    pipeline_forward,
+    split_microbatches,
+    stack_stage_params,
+)
+
+
+class Block(nn.Module):
+    width: int = 8
+
+    @nn.compact
+    def __call__(self, x):
+        return x + nn.tanh(nn.Dense(self.width, name="fc")(x))
+
+
+def _stacked_params(L=8, width=8, seed=0):
+    block = Block(width)
+    params = [block.init(jax.random.PRNGKey(seed + i),
+                         jnp.zeros((1, width)))["params"]
+              for i in range(L)]
+    return block, stack_stage_params(params)
+
+
+def _sequential_ref(block, stacked, x):
+    L = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    for i in range(L):
+        p = jax.tree_util.tree_map(lambda a: a[i], stacked)
+        x = block.apply({"params": p}, x)
+    return x
+
+
+class TestPipelineForward:
+    def test_matches_sequential(self):
+        mesh = create_mesh((8,), axis_names=("pipe",))
+        block, stacked = _stacked_params()
+        x = jnp.asarray(np.random.RandomState(1).randn(16, 8), jnp.float32)
+        mbs = split_microbatches(x, 4)               # (4, 4, 8)
+
+        out = pipeline_forward(
+            lambda p, a: block.apply({"params": p}, a), stacked, mbs, mesh)
+        ref = _sequential_ref(block, stacked, x).reshape(4, 4, 8)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_single_microbatch(self):
+        mesh = create_mesh((8,), axis_names=("pipe",))
+        block, stacked = _stacked_params()
+        x = jnp.asarray(np.random.RandomState(2).randn(2, 8), jnp.float32)
+        out = pipeline_forward(
+            lambda p, a: block.apply({"params": p}, a), stacked,
+            x[None], mesh)
+        ref = _sequential_ref(block, stacked, x)
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_2d_pipe_data_mesh(self):
+        mesh = create_mesh((4, 2), axis_names=("pipe", "data"))
+        block, stacked = _stacked_params(L=4)
+        x = jnp.asarray(np.random.RandomState(3).randn(8, 8), jnp.float32)
+        mbs = split_microbatches(x, 2)
+        out = pipeline_forward(
+            lambda p, a: block.apply({"params": p}, a), stacked, mbs, mesh,
+            batch_axis="data")
+        ref = _sequential_ref(block, stacked, x).reshape(2, 4, 8)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestPipelineBackward:
+    def test_grad_matches_sequential(self):
+        """jax.grad through the pipeline = the backward-pipelined GPipe
+        schedule; gradients must match the sequential stack's."""
+        mesh = create_mesh((8,), axis_names=("pipe",))
+        block, stacked = _stacked_params()
+        x = jnp.asarray(np.random.RandomState(4).randn(8, 8), jnp.float32)
+        mbs = split_microbatches(x, 2)
+        tgt = jnp.ones((8, 8)) * 0.3
+
+        def loss_pipe(p):
+            y = pipeline_forward(
+                lambda q, a: block.apply({"params": q}, a), p, mbs, mesh)
+            return jnp.mean((y.reshape(8, 8) - tgt) ** 2)
+
+        def loss_seq(p):
+            y = _sequential_ref(block, p, x)
+            return jnp.mean((y - tgt) ** 2)
+
+        g_pipe = jax.grad(loss_pipe)(stacked)
+        g_seq = jax.grad(loss_seq)(stacked)
+        for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                        jax.tree_util.tree_leaves(g_seq)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_training_reduces_loss(self):
+        mesh = create_mesh((8,), axis_names=("pipe",))
+        block, stacked = _stacked_params()
+        rng = np.random.RandomState(5)
+        x = jnp.asarray(rng.randn(8, 8), jnp.float32)
+        tgt = jnp.asarray(np.tanh(rng.randn(8, 8)), jnp.float32)
+        mbs = split_microbatches(x, 2)
+
+        @jax.jit
+        def step(p):
+            def loss(p):
+                y = pipeline_forward(
+                    lambda q, a: block.apply({"params": q}, a), p, mbs, mesh)
+                return jnp.mean((y.reshape(8, 8) - tgt) ** 2)
+            l, g = jax.value_and_grad(loss)(p)
+            return jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, p, g), l
+
+        p = stacked
+        losses = []
+        for _ in range(20):
+            p, l = step(p)
+            losses.append(float(l))
+        assert losses[-1] < losses[0] * 0.8, losses[:3] + losses[-3:]
+
+
+class TestSplitMicrobatches:
+    def test_shapes(self):
+        x = jnp.zeros((12, 5))
+        assert split_microbatches(x, 3).shape == (3, 4, 5)
+        with pytest.raises(ValueError, match="divisible"):
+            split_microbatches(x, 5)
+
+    def test_stage_count_mismatch_raises(self):
+        mesh = create_mesh((8,), axis_names=("pipe",))
+        block, stacked16 = _stacked_params(L=16)
+        x = jnp.zeros((1, 2, 8))
+        with pytest.raises(ValueError, match="one stage per device"):
+            pipeline_forward(
+                lambda p, a: block.apply({"params": p}, a), stacked16, x, mesh)
