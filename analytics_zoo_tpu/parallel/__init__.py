@@ -44,6 +44,11 @@ from analytics_zoo_tpu.parallel.train import (
 )
 from analytics_zoo_tpu.parallel.summary import TrainSummary, ValidationSummary
 from analytics_zoo_tpu.parallel import checkpoint
+from analytics_zoo_tpu.parallel.expert import (
+    moe_apply_dense,
+    moe_apply_expert_parallel,
+    route_top1,
+)
 from analytics_zoo_tpu.parallel.pipeline import (
     pipeline_forward,
     split_microbatches,
