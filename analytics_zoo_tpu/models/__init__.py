@@ -24,6 +24,7 @@ from analytics_zoo_tpu.models.deepspeech2 import (
 from analytics_zoo_tpu.models.attention import (
     AttentionASR,
     LongContextEncoder,
+    MoEFeedForward,
     MultiHeadSelfAttention,
     TransformerBlock,
 )

@@ -48,11 +48,73 @@ class MultiHeadSelfAttention(nn.Module):
         return nn.Dense(self.dim, name="proj")(out.reshape(B, T, self.dim))
 
 
+class MoEFeedForward(nn.Module):
+    """Mixture-of-experts MLP (the ``parallel.expert`` consumer): tokens
+    are top-1-routed to ``n_experts`` gelu MLPs with static capacity.
+    ``expert_mesh=None`` runs the dense einsum path on one program;
+    passing a mesh with an ``expert`` axis switches to all_to_all expert
+    parallelism.  Routing decisions are identical across the two paths,
+    but capacity semantics differ — dense applies ``capacity_factor``
+    globally, expert-parallel per (sender shard, expert) pair — so
+    outputs coincide exactly only when capacity admits every token
+    (large ``capacity_factor``); under routing skew the EP path drops
+    fewer tokens than dense."""
+
+    dim: int
+    n_experts: int = 8
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    expert_mesh: Optional[object] = None
+
+    @nn.compact
+    def __call__(self, x):
+        from analytics_zoo_tpu.parallel.expert import (
+            default_capacity, moe_apply_dense, moe_apply_expert_parallel)
+
+        B, T, D = x.shape
+        if D != self.dim:
+            raise ValueError(f"input feature dim {D} != configured "
+                             f"dim {self.dim}")
+        hidden = D * self.mlp_ratio
+        dense_init = nn.initializers.lecun_normal()
+        stacked = {
+            "w1": self.param("w1", dense_init, (self.n_experts, D, hidden)),
+            "b1": self.param("b1", nn.initializers.zeros,
+                             (self.n_experts, hidden)),
+            "w2": self.param("w2", dense_init, (self.n_experts, hidden, D)),
+            "b2": self.param("b2", nn.initializers.zeros,
+                             (self.n_experts, D)),
+        }
+        gate_k = self.param("gate", nn.initializers.lecun_normal(),
+                            (D, self.n_experts))
+
+        def apply_expert(p, a):
+            return nn.gelu(a @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"]
+
+        toks = x.reshape(B * T, D)
+        if self.expert_mesh is not None:
+            n = self.expert_mesh.shape["expert"]
+            cap = default_capacity(toks.shape[0] // n, self.n_experts,
+                                   self.capacity_factor)
+            y = moe_apply_expert_parallel(apply_expert, stacked, gate_k,
+                                          toks, self.expert_mesh,
+                                          capacity=cap)
+        else:
+            y = moe_apply_dense(
+                apply_expert, stacked, gate_k, toks,
+                capacity=default_capacity(toks.shape[0], self.n_experts,
+                                          self.capacity_factor))
+        return y.reshape(B, T, D)
+
+
 class TransformerBlock(nn.Module):
     dim: int
     num_heads: int = 4
     mlp_ratio: int = 4
     attention_fn: Callable = full_attention
+    n_experts: int = 0                  # > 0 → MoE feed-forward
+    expert_mesh: Optional[object] = None
+    capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x):
@@ -61,6 +123,11 @@ class TransformerBlock(nn.Module):
             dim=self.dim, num_heads=self.num_heads,
             attention_fn=self.attention_fn, name="attn")(h)
         h = nn.LayerNorm(name="ln2")(x)
+        if self.n_experts > 0:
+            return x + MoEFeedForward(
+                dim=self.dim, n_experts=self.n_experts,
+                mlp_ratio=self.mlp_ratio, expert_mesh=self.expert_mesh,
+                capacity_factor=self.capacity_factor, name="moe")(h)
         h = nn.Dense(self.dim * self.mlp_ratio, name="mlp1")(h)
         h = nn.gelu(h)
         return x + nn.Dense(self.dim, name="mlp2")(h)
@@ -74,6 +141,9 @@ class LongContextEncoder(nn.Module):
     depth: int = 4
     num_heads: int = 4
     attention_fn: Callable = full_attention
+    n_experts: int = 0                  # > 0 → MoE feed-forward blocks
+    expert_mesh: Optional[object] = None
+    capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x):
@@ -83,6 +153,9 @@ class LongContextEncoder(nn.Module):
         for i in range(self.depth):
             h = TransformerBlock(dim=self.dim, num_heads=self.num_heads,
                                  attention_fn=self.attention_fn,
+                                 n_experts=self.n_experts,
+                                 expert_mesh=self.expert_mesh,
+                                 capacity_factor=self.capacity_factor,
                                  name=f"block{i}")(h)
         return nn.LayerNorm(name="ln_out")(h)
 
