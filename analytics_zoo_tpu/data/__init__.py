@@ -11,6 +11,7 @@ from analytics_zoo_tpu.data.transformer import (
     ParallelTransformer,
     Pipeline,
     RandomTransformer,
+    ShuffleBuffer,
     Transformer,
 )
 from analytics_zoo_tpu.data.dataset import (

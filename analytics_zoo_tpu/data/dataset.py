@@ -108,6 +108,13 @@ class DataSet:
               drop_remainder: bool = True) -> "DataSet":
         return self.transform(Batcher(batch_size, collate_fn, drop_remainder))
 
+    def shuffle(self, buffer_size: int = 1024, seed: Optional[int] = None
+                ) -> "DataSet":
+        """Record-level windowed shuffle (``transformer.ShuffleBuffer``)."""
+        from analytics_zoo_tpu.data.transformer import ShuffleBuffer
+        rng = random.Random(seed) if seed is not None else None
+        return self.transform(ShuffleBuffer(buffer_size, rng=rng))
+
     # -- iteration ---------------------------------------------------------
     def __iter__(self) -> Iterator[Any]:
         it = self._source_fn()

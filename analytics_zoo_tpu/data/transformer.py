@@ -176,3 +176,42 @@ class RandomTransformer(Transformer):
         if self.rng.random() < self.prob:
             return self.inner.transform(sample)
         return sample
+
+
+class ShuffleBuffer(Transformer):
+    """Streaming record-level shuffle with a bounded ``buffer_size``
+    window (the tf.data ``shuffle()`` pattern): fill the buffer, then for
+    every incoming sample emit a uniformly-drawn buffered one and replace
+    it.  Replaces the global shuffle the reference got for free from
+    Spark RDD repartitioning — a full in-memory shuffle is impossible for
+    multi-GB record sets on a TPU host, a windowed one is O(buffer).
+
+    Approximation quality scales with ``buffer_size``; combine with
+    ``shuffle_files=True`` on the record source so the window isn't
+    limited to one shard's ordering."""
+
+    def __init__(self, buffer_size: int = 1024,
+                 rng: Optional[random.Random] = None):
+        if buffer_size < 1:
+            raise ValueError("buffer_size must be >= 1")
+        self.buffer_size = buffer_size
+        self.rng = rng or random.Random()
+
+    def transform(self, sample: Any) -> Any:
+        raise TypeError(
+            "ShuffleBuffer is a stream (many-to-many) transformer; it "
+            "cannot run per-sample inside ParallelTransformer or a "
+            "per-sample chain — attach it with DataSet.shuffle()/"
+            ".transform() directly")
+
+    def apply_iter(self, it: Iterator[Any]) -> Iterator[Any]:
+        buf: list = []
+        for sample in it:
+            if len(buf) < self.buffer_size:
+                buf.append(sample)
+                continue
+            j = self.rng.randrange(self.buffer_size)
+            buf[j], sample = sample, buf[j]
+            yield sample
+        self.rng.shuffle(buf)
+        yield from buf

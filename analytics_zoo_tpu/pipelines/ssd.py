@@ -83,6 +83,11 @@ class PreProcessParam:
     # host augmentation worker threads (SURVEY.md §7.3 hard part 4);
     # 1 = serial (deterministic order), >1 = ParallelTransformer pool
     num_workers: int = 1
+    # record-level windowed shuffle (data.ShuffleBuffer) applied to the
+    # decoded record stream; 0 disables (file-order shuffle still on).
+    # Replaces the global shuffle Spark RDD repartitioning provided.
+    shuffle_buffer: int = 0
+    shuffle_seed: int = 0
 
 
 class RecordToFeature(Transformer):
@@ -195,18 +200,22 @@ def load_train_set_device(pattern: str, param: PreProcessParam,
                                 pixel_means=tuple(param.pixel_means))
     chain = (RecordToFeature() >> BytesToMat() >> RoiNormalize()
              >> DeviceAugPrepare(aug))
-    ds = (DataSet.from_record_files(pattern, SSDByteRecord.decode,
-                                    shuffle_files=True)
-          .transform(_maybe_parallel(chain, param.num_workers))
+    ds = DataSet.from_record_files(pattern, SSDByteRecord.decode,
+                                   shuffle_files=True)
+    if param.shuffle_buffer:
+        ds = ds.shuffle(param.shuffle_buffer, seed=param.shuffle_seed)
+    ds = (ds.transform(_maybe_parallel(chain, param.num_workers))
           .transform(DeviceAugBatch(param.batch_size, param.max_gt)))
     return ds, make_device_augment(aug)
 
 
 def load_train_set(pattern: str, param: PreProcessParam) -> DataSet:
-    return (DataSet.from_record_files(pattern, SSDByteRecord.decode,
-                                      shuffle_files=True)
-            .transform(_maybe_parallel(train_transformer(param),
-                                       param.num_workers))
+    ds = DataSet.from_record_files(pattern, SSDByteRecord.decode,
+                                   shuffle_files=True)
+    if param.shuffle_buffer:
+        ds = ds.shuffle(param.shuffle_buffer, seed=param.shuffle_seed)
+    return (ds.transform(_maybe_parallel(train_transformer(param),
+                                         param.num_workers))
             .transform(RoiImageToBatch(param.batch_size, param.max_gt)))
 
 

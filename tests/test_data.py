@@ -172,3 +172,54 @@ def test_clone_reseeds_rng():
     sa = [a.rng.random() for _ in range(32)]
     sb = [b.rng.random() for _ in range(32)]
     assert sa != sb
+
+
+class TestShuffleBuffer:
+    def test_permutation_no_loss(self):
+        from analytics_zoo_tpu.data import DataSet
+
+        ds = DataSet.from_list(list(range(500))).shuffle(64, seed=0)
+        out = list(ds)
+        assert sorted(out) == list(range(500))
+        assert out != list(range(500))      # actually shuffled
+
+    def test_window_locality(self):
+        """With buffer B, an element cannot be emitted more than B
+        positions EARLY (output slot q drains while reading stream
+        position q+B, so everything buffered has original index <= q+B);
+        lingering arbitrarily late is allowed."""
+        from analytics_zoo_tpu.data import DataSet
+
+        B = 32
+        out = list(DataSet.from_list(list(range(1000))).shuffle(B, seed=1))
+        for pos, v in enumerate(out):
+            assert v <= pos + B, (pos, v)
+
+    def test_seed_reproducible(self):
+        from analytics_zoo_tpu.data import DataSet
+
+        a = list(DataSet.from_list(list(range(100))).shuffle(16, seed=7))
+        b = list(DataSet.from_list(list(range(100))).shuffle(16, seed=7))
+        assert a == b
+
+    def test_short_stream(self):
+        from analytics_zoo_tpu.data import DataSet
+
+        out = list(DataSet.from_list([1, 2, 3]).shuffle(100, seed=0))
+        assert sorted(out) == [1, 2, 3]
+
+    def test_invalid_buffer(self):
+        import pytest as _pytest
+
+        from analytics_zoo_tpu.data import ShuffleBuffer
+
+        with _pytest.raises(ValueError):
+            ShuffleBuffer(0)
+
+    def test_per_sample_misuse_raises(self):
+        import pytest as _pytest
+
+        from analytics_zoo_tpu.data import ShuffleBuffer
+
+        with _pytest.raises(TypeError, match="many-to-many"):
+            ShuffleBuffer(4).transform(1)
