@@ -42,17 +42,16 @@ def device_prefetch(batches: Iterable[Any], mesh, size: int = 2) -> Iterator[Any
         except BaseException as e:  # propagate to consumer
             err.append(e)
         finally:
-            while True:
+            # Block until the stop sentinel fits — NEVER pop queued real
+            # batches to make room (a slow consumer keeps the queue full
+            # at end-of-stream, and popping would silently drop batches).
+            # A cancelled consumer is gone and needs no sentinel.
+            while not cancelled.is_set():
                 try:
-                    q.put_nowait(stop)
+                    q.put(stop, timeout=0.1)
                     break
                 except queue.Full:
-                    if cancelled.is_set():
-                        break
-                    try:
-                        q.get_nowait()
-                    except queue.Empty:
-                        pass
+                    continue
 
     t = threading.Thread(target=worker, daemon=True)
     t.start()

@@ -324,7 +324,7 @@ class Optimizer:
                  skip_loss_above: Optional[float] = None,
                  grad_clip_norm: Optional[float] = None,
                  compute_dtype=None, device_transform=None,
-                 param_rules=None):
+                 param_rules=None, prefetch: int = 0):
         self.model = model
         self.dataset = dataset
         self.criterion = criterion
@@ -348,6 +348,10 @@ class Optimizer:
         # tensor-parallel sharding rules (parallel.tensor); None = pure
         # data-parallel replication
         self.param_rules = param_rules
+        # > 0: shard+transfer batches on a background thread, staying
+        # `prefetch` ahead of the device (data.prefetch double-buffering,
+        # SURVEY.md §3.1 HOT LOOP #1 overlap)
+        self.prefetch = prefetch
         self._score_name: Optional[str] = None
         self.resume_path: Optional[str] = None
         self._resume_requested = False
@@ -424,43 +428,61 @@ class Optimizer:
         )
         eval_step = make_eval_step(self.model.module,
                                    compute_dtype=self.compute_dtype)
+        if self.prefetch:
+            from analytics_zoo_tpu.data.prefetch import device_prefetch
         t_epoch = time.time()
         records = 0
         stop = False
+        sentinel = object()
         while not stop and not self.end_when(loop):
             loop.epoch_finished = False
-            for batch in self.dataset:
-                if self._skip_batches > 0:
-                    # mid-epoch resume: fast-forward past already-trained
-                    # batches of the interrupted epoch
-                    self._skip_batches -= 1
-                    self._iter_in_epoch += 1
-                    continue
-                n = _batch_size(batch)
-                dev_batch = mesh_lib.shard_batch(batch, self.mesh)
-                if self.device_transform is not None:
-                    dev_batch = self.device_transform(dev_batch)
-                state, metrics = train_step(state, dev_batch, self.optim.lr_scale)
-                loop.iteration += 1
-                self._iter_in_epoch += 1
-                records += n
-                if (self.failure_detector is not None
-                        and self.failure_detector.should_check(loop.iteration)):
-                    self.failure_detector.check(float(metrics["loss"]),
-                                                loop.iteration)
-                # keep the loss as a device array — only force a host sync
-                # when something host-side actually reads it
-                loop.loss = metrics["loss"]
-                if self.train_summary is not None:
-                    self.train_summary.add_scalar(
-                        "Loss", float(metrics["loss"]), loop.iteration)
-                    self.train_summary.add_scalar(
-                        "LearningRate", float(metrics["lr"]), loop.iteration)
-                self._maybe_validate(loop, state, eval_step)
-                self._maybe_checkpoint(loop, state)
-                if self.end_when(loop):
-                    stop = True
+            host_iter = iter(self.dataset)
+            # mid-epoch resume: fast-forward past already-trained batches
+            # ON THE HOST — never shard/transfer data that will be dropped
+            while self._skip_batches > 0:
+                if next(host_iter, sentinel) is sentinel:
                     break
+                self._skip_batches -= 1
+                self._iter_in_epoch += 1
+            epoch_batches = (device_prefetch(host_iter, self.mesh,
+                                             self.prefetch)
+                             if self.prefetch else host_iter)
+            try:
+                for batch in epoch_batches:
+                    n = _batch_size(batch)
+                    dev_batch = (batch if self.prefetch
+                                 else mesh_lib.shard_batch(batch, self.mesh))
+                    if self.device_transform is not None:
+                        dev_batch = self.device_transform(dev_batch)
+                    state, metrics = train_step(state, dev_batch,
+                                                self.optim.lr_scale)
+                    loop.iteration += 1
+                    self._iter_in_epoch += 1
+                    records += n
+                    if (self.failure_detector is not None
+                            and self.failure_detector.should_check(
+                                loop.iteration)):
+                        self.failure_detector.check(float(metrics["loss"]),
+                                                    loop.iteration)
+                    # keep the loss as a device array — only force a host
+                    # sync when something host-side actually reads it
+                    loop.loss = metrics["loss"]
+                    if self.train_summary is not None:
+                        self.train_summary.add_scalar(
+                            "Loss", float(metrics["loss"]), loop.iteration)
+                        self.train_summary.add_scalar(
+                            "LearningRate", float(metrics["lr"]),
+                            loop.iteration)
+                    self._maybe_validate(loop, state, eval_step)
+                    self._maybe_checkpoint(loop, state)
+                    if self.end_when(loop):
+                        stop = True
+                        break
+            finally:
+                # early exit (end_when break / detector raise): release
+                # the prefetch worker and its HBM-pinned queued batches
+                if hasattr(epoch_batches, "close"):
+                    epoch_batches.close()
             if stop:
                 break  # partial epoch: don't count or re-trigger it
             loop.epoch += 1
@@ -565,7 +587,10 @@ class Optimizer:
 
 def _batch_size(batch) -> int:
     leaf = jax.tree_util.tree_leaves(batch)[0]
-    return int(np.asarray(leaf).shape[0])
+    # .shape directly: np.asarray on a device-resident (prefetched) leaf
+    # would device_get the whole array just to read its shape
+    shape = getattr(leaf, "shape", None)
+    return int(shape[0]) if shape else int(np.asarray(leaf).shape[0])
 
 
 def validate(module, variables, dataset, methods: Sequence[ValidationMethod],

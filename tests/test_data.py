@@ -223,3 +223,18 @@ class TestShuffleBuffer:
 
         with _pytest.raises(TypeError, match="many-to-many"):
             ShuffleBuffer(4).transform(1)
+
+
+def test_device_prefetch_slow_consumer_no_drops():
+    """Regression: with a consumer slower than the producer the queue is
+    full at end-of-stream; the worker must BLOCK until the stop sentinel
+    fits, never pop (drop) queued batches to make room."""
+    import time
+
+    mesh = create_mesh()
+    batches = [{"x": np.full((8, 2), i, np.float32)} for i in range(6)]
+    seen = []
+    for b in device_prefetch(batches, mesh, size=2):
+        time.sleep(0.05)            # slow consumer keeps the queue full
+        seen.append(float(b["x"][0, 0]))
+    assert seen == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
