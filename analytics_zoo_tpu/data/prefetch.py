@@ -22,6 +22,10 @@ def device_prefetch(batches: Iterable[Any], mesh, size: int = 2) -> Iterator[Any
     handled: closing the generator signals the worker to stop, so no thread
     is left blocked holding device buffers.
     """
+    if size < 1:
+        # a non-positive maxsize would make the Queue UNBOUNDED and the
+        # worker would transfer the whole epoch into HBM ahead of compute
+        raise ValueError(f"prefetch size must be >= 1, got {size}")
     q: "queue.Queue" = queue.Queue(maxsize=size)
     stop = object()
     cancelled = threading.Event()

@@ -355,6 +355,8 @@ class TrainParams:
     max_gt: int = 100
     # MXU-native mixed precision (fp32 masters, bf16 compute); None = fp32
     compute_dtype: Optional[str] = "bf16"
+    # background shard+transfer depth (Optimizer prefetch); 0 = sync
+    prefetch: int = 2
 
 
 def train_ssd(train_set, val_set, params: TrainParams,
@@ -377,7 +379,8 @@ def train_ssd(train_set, val_set, params: TrainParams,
     def make_optimizer(optim_method, end_when):
         opt = (Optimizer(model, train_set, criterion, mesh=mesh,
                          skip_loss_above=50.0,
-                         compute_dtype=params.compute_dtype)
+                         compute_dtype=params.compute_dtype,
+                         prefetch=params.prefetch)
                .set_optim_method(optim_method)
                .set_end_when(end_when))
         if val_set is not None:

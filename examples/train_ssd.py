@@ -31,6 +31,12 @@ def main():
     p.add_argument("--job-name", default="ssd300")
     p.add_argument("--weights-npz", default=None,
                    help="pretrained backbone weights (converter npz)")
+    p.add_argument("--shuffle-buffer", type=int, default=1024,
+                   help="record-level shuffle window (0 = file order only)")
+    p.add_argument("--num-workers", type=int, default=1,
+                   help="host augmentation worker threads")
+    p.add_argument("--prefetch", type=int, default=2,
+                   help="device prefetch depth (0 = synchronous)")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -38,7 +44,9 @@ def main():
         PreProcessParam, TrainParams, load_train_set, load_val_set, train_ssd)
 
     pre = PreProcessParam(batch_size=args.batch_size,
-                          resolution=args.resolution)
+                          resolution=args.resolution,
+                          num_workers=args.num_workers,
+                          shuffle_buffer=args.shuffle_buffer)
     train_set = load_train_set(args.train_records, pre)
     val_set = (load_val_set(args.val_records, pre)
                if args.val_records else None)
@@ -49,7 +57,8 @@ def main():
         lr_steps=args.lr_steps, warm_up_map=args.warmup_map,
         checkpoint_path=args.checkpoint,
         overwrite_checkpoint=not args.no_overwrite_checkpoint,
-        log_dir=args.summary_dir, job_name=args.job_name)
+        log_dir=args.summary_dir, job_name=args.job_name,
+        prefetch=args.prefetch)
 
     model = None
     if args.weights_npz:

@@ -238,3 +238,9 @@ def test_device_prefetch_slow_consumer_no_drops():
         time.sleep(0.05)            # slow consumer keeps the queue full
         seen.append(float(b["x"][0, 0]))
     assert seen == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+
+
+def test_device_prefetch_rejects_nonpositive_size():
+    mesh = create_mesh()
+    with pytest.raises(ValueError, match=">= 1"):
+        list(device_prefetch([{"x": np.ones((8, 2))}], mesh, size=-1))
