@@ -24,6 +24,7 @@ from typing import Any, Callable, Optional, Sequence
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import serialization
 from flax.core import FrozenDict
 
@@ -184,6 +185,22 @@ class Model:
     def params(self):
         v = self.variables
         return v["params"] if "params" in v else v
+
+    def summary(self, *example_inputs, depth: Optional[int] = None,
+                **kwargs) -> str:
+        """Module/parameter table (the BigDL module-tree printout
+        ergonomics): per-submodule output shapes and param counts via
+        ``flax.linen.tabulate`` — shape-only tracing, no FLOPs spent."""
+        tab = nn.tabulate(self.module, jax.random.PRNGKey(0), depth=depth,
+                          console_kwargs={"width": 100})
+        return tab(*example_inputs, **kwargs)
+
+    def parameter_count(self) -> int:
+        """Total trainable parameter count."""
+        if self.variables is None:
+            raise ValueError("build() the model first")
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(self.params))
 
     def evaluate(self) -> "Model":
         """Switch to inference mode (reference ``model.evaluate()``)."""

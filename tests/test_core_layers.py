@@ -267,3 +267,26 @@ def test_model_train_forward_jitted_updates_batch_stats():
     # second call reuses the compiled callable and keeps advancing stats
     m.forward(x)
     assert not jnp.allclose(after, stats(m))
+
+
+class TestModelIntrospection:
+    def test_parameter_count(self):
+        import jax.numpy as jnp
+        from flax import linen as nn
+
+        from analytics_zoo_tpu.core.module import Model
+
+        m = Model(nn.Dense(4))
+        m.build(0, jnp.zeros((1, 8)))
+        assert m.parameter_count() == 8 * 4 + 4
+
+    def test_summary_table(self):
+        import jax.numpy as jnp
+        from flax import linen as nn
+
+        from analytics_zoo_tpu.core.module import Model
+
+        m = Model(nn.Sequential([nn.Dense(16), nn.relu, nn.Dense(2)]))
+        s = m.summary(jnp.zeros((1, 8)))
+        assert "Dense" in s and "params" in s
+

@@ -122,3 +122,22 @@ class TestGetPascal:
         assert len(back) == 4
         assert all(b.gt.shape == (1, 6) for b in back)
         assert all(b.gt[0, 0] == 12.0 for b in back)  # dog class id
+
+
+class TestReportHelper:
+    def test_append_report_and_command(self, tmp_path, monkeypatch):
+        import json
+
+        from analytics_zoo_tpu.utils.report import (append_report,
+                                                    reconstruct_command)
+
+        monkeypatch.setattr("sys.argv",
+                            ["x.py", "--epochs", "3", "--out", "f.md",
+                             "--flag"])
+        cmd = reconstruct_command("examples/x.py")
+        assert cmd == "python examples/x.py --epochs 3 --flag"
+        out = tmp_path / "acc.md"
+        append_report(str(out), "T", "examples/x.py", {"a": 1})
+        text = out.read_text()
+        assert "## T" in text and json.loads(
+            text.split("```json\n")[1].split("```")[0]) == {"a": 1}
