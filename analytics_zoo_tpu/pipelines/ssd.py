@@ -234,7 +234,8 @@ class SSDPredictor:
 
     def __init__(self, model: Model, param: PreProcessParam,
                  post: Optional[DetectionOutputParam] = None,
-                 n_classes: int = 21, compute_dtype=None):
+                 n_classes: int = 21, compute_dtype=None,
+                 quantize: bool = False):
         self.model = model
         self.param = param
         self.post = post or DetectionOutputParam(n_classes=n_classes)
@@ -242,8 +243,22 @@ class SSDPredictor:
             ssd300_config() if param.resolution == 300 else ssd512_config())
         self._priors = jnp.asarray(priors)
         self._variances = jnp.asarray(variances)
-        self._eval_step = make_eval_step(model.module,
-                                         compute_dtype=compute_dtype)
+        # quantized mode snapshots int8 weights and drops the Model
+        # reference so the caller CAN release the fp32 tree (otherwise the
+        # 4x HBM saving never materializes); fp32 mode reads
+        # model.variables at call time so later load_weights take effect
+        self._variables = None
+        if quantize:
+            from analytics_zoo_tpu.parallel.train import resolve_compute_dtype
+            from analytics_zoo_tpu.utils.quantize import (
+                make_quantized_forward, quantize_params)
+            self._variables = quantize_params(model.variables)
+            self._eval_step = make_quantized_forward(
+                model.module, resolve_compute_dtype(compute_dtype))
+            self.model = None
+        else:
+            self._eval_step = make_eval_step(model.module,
+                                             compute_dtype=compute_dtype)
 
     def set_top_k(self, k: int) -> "SSDPredictor":
         """Mutate keep_topk (reference ``setTopK`` mutating DetectionOutput)."""
@@ -254,7 +269,9 @@ class SSDPredictor:
         """Forward + softmax + DetectionOutput → (B, K, 6) normalized-box
         detections (shared by predict and Validator so serving and eval
         can't diverge)."""
-        loc, conf = self._eval_step(self.model.variables, jnp.asarray(inputs))
+        variables = (self._variables if self._variables is not None
+                     else self.model.variables)
+        loc, conf = self._eval_step(variables, jnp.asarray(inputs))
         probs = jax.nn.softmax(conf, axis=-1)
         return detection_output(loc, probs, self._priors, self._variances,
                                 self.post)

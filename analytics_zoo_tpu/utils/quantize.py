@@ -1,0 +1,151 @@
+"""Post-training int8 weight quantization for serving.
+
+Net-new capability (the reference serves fp32 through MKL; SURVEY.md
+§2.6).  TPU-first design: weights are stored as per-output-channel
+symmetric int8 (``QTensor`` — int8 values + one fp32 scale per trailing
+axis), cutting parameter HBM ~4×; the forward **dequantizes inside
+jit**, so XLA fuses the ``q * scale`` broadcast into the adjacent
+matmul/conv and the bf16/fp32 MXU path is unchanged.  No activation
+quantization — this is lossless-ergonomics serving compression, not QAT.
+
+Usage::
+
+    qparams = quantize_params(model.params)         # ~4x smaller pytree
+    fwd = make_quantized_forward(model.module)      # jitted
+    y = fwd(qparams, x)                             # == model.forward(x) ± eps
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_PATTERN = r"(^|.*/)(kernel|embedding)$"
+
+
+@jax.tree_util.register_pytree_node_class
+class QTensor:
+    """Symmetric per-trailing-axis int8 quantized tensor."""
+
+    def __init__(self, q: jax.Array, scale: jax.Array):
+        self.q = q          # int8, original shape
+        self.scale = scale  # f32, shape (trailing_dim,)
+
+    def dequant(self, dtype=jnp.float32) -> jax.Array:
+        return self.q.astype(dtype) * self.scale.astype(dtype)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return f"QTensor(shape={tuple(self.q.shape)}, int8)"
+
+
+def quantize_tensor(w) -> QTensor:
+    """w (..., C) → int8 values + per-C scale (symmetric, round-to-nearest)."""
+    w = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w), axis=tuple(range(w.ndim - 1)))     # (C,)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    return QTensor(jnp.asarray(q), jnp.asarray(scale))
+
+
+def quantize_params(params: Any,
+                    pattern: str = DEFAULT_PATTERN,
+                    min_size: int = 4096) -> Any:
+    """Replace every ≥2-D leaf whose path matches ``pattern`` (and holds
+    at least ``min_size`` elements — tiny tensors aren't worth the
+    rounding error) with a :class:`QTensor`; everything else passes
+    through untouched."""
+    rx = re.compile(pattern)
+
+    def maybe_q(path_entries, leaf):
+        path = "/".join(str(getattr(e, "key", getattr(e, "name", e)))
+                        for e in path_entries)
+        arr = np.asarray(leaf)
+        if (arr.ndim >= 2 and arr.size >= min_size and rx.match(path)):
+            return quantize_tensor(arr)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(maybe_q, params)
+
+
+def dequantize_params(qparams: Any, dtype=jnp.float32) -> Any:
+    return jax.tree_util.tree_map(
+        lambda t: t.dequant(dtype) if isinstance(t, QTensor) else t,
+        qparams, is_leaf=lambda x: isinstance(x, QTensor))
+
+
+def _cast_floating(tree, dtype):
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x, tree)
+
+
+def make_quantized_forward(module, dtype=None,
+                           apply_fn: Optional[Callable] = None) -> Callable:
+    """Jitted ``fwd(qparams, *inputs)``: dequantization happens inside
+    the traced program so XLA fuses it into the consuming matmul/conv —
+    int8 lives in HBM, fp enters the MXU.
+
+    The default apply runs the module in eval mode (``train=False`` when
+    the module takes it).  ``dtype`` (e.g. ``jnp.bfloat16``) mirrors
+    ``make_eval_step``'s mixed precision: dequant happens in fp32 for
+    accuracy, then weights AND inputs are cast to ``dtype`` so the MXU
+    actually runs at that precision, with outputs cast back to fp32."""
+    if apply_fn is None:
+        import inspect
+
+        # only pass train= when __call__ NAMES it — containers like
+        # nn.Sequential advertise **kwargs but forward them to layers
+        # that reject the keyword
+        sig = inspect.signature(type(module).__call__)
+        kw = {"train": False} if "train" in sig.parameters else {}
+
+        def apply_fn(variables, *a):
+            return module.apply(variables, *a, **kw)
+
+    mixed = dtype is not None and dtype != jnp.float32
+
+    @jax.jit
+    def fwd(qvariables, *inputs):
+        variables = dequantize_params(qvariables, jnp.float32)
+        if mixed:
+            variables = _cast_floating(variables, dtype)
+            inputs = _cast_floating(inputs, dtype)
+        out = apply_fn(variables, *inputs)
+        if mixed:
+            out = _cast_floating(out, jnp.float32)
+        return out
+
+    return fwd
+
+
+def quantized_nbytes(tree: Any) -> Tuple[int, int]:
+    """(quantized_bytes, fp32_equivalent_bytes) across the pytree."""
+    qb = fb = 0
+    for leaf in jax.tree_util.tree_leaves(
+            tree, is_leaf=lambda x: isinstance(x, QTensor)):
+        if isinstance(leaf, QTensor):
+            n = int(np.prod(leaf.q.shape))
+            qb += n + 4 * int(np.prod(leaf.scale.shape))
+            fb += 4 * n
+        else:
+            n = int(np.prod(np.shape(leaf)))
+            itemsize = np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+            qb += itemsize * n
+            fb += 4 * n
+    return qb, fb
