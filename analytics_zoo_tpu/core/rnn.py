@@ -84,7 +84,10 @@ class Recurrent(nn.Module):
     reverse: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, carry0=None, return_carry: bool = False):
+        """``carry0``/``return_carry`` expose the scan's boundary state for
+        streaming inference (chunked input, state carried across calls);
+        params are identical either way."""
         if self.reverse:
             x = jnp.flip(x, axis=1)
         scan = nn.scan(
@@ -99,11 +102,12 @@ class Recurrent(nn.Module):
             for k in type(self.cell).__dataclass_fields__
             if k not in ("parent", "name")
         }
-        carry = self.cell.initial_carry(x.shape[0], x.dtype)
-        _, ys = scan(**cell_kwargs, name="body")(carry, x)
+        carry = (carry0 if carry0 is not None
+                 else self.cell.initial_carry(x.shape[0], x.dtype))
+        final, ys = scan(**cell_kwargs, name="body")(carry, x)
         if self.reverse:
             ys = jnp.flip(ys, axis=1)
-        return ys
+        return (ys, final) if return_carry else ys
 
 
 class BiRecurrent(nn.Module):

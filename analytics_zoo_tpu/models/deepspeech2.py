@@ -32,7 +32,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from analytics_zoo_tpu.core.rnn import BiRecurrent, RnnCell
+from analytics_zoo_tpu.core.rnn import BiRecurrent, Recurrent, RnnCell
 
 
 class SequenceBN(nn.Module):
@@ -60,31 +60,54 @@ class DeepSpeech2(nn.Module):
     n_alphabet: int = 29
     n_mels: int = 13
     conv_channels: int = 32
+    # False → forward-only recurrence (streamable: no future dependence
+    # beyond the conv's 5-frame lookahead); param names differ from the
+    # bidirectional model (rnn{i} vs birnn{i})
+    bidirectional: bool = True
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, carry=None,
+                 return_carry: bool = False):
+        """``carry``/``return_carry`` enable exact streaming inference
+        (unidirectional only): ``carry = {"h": (per-layer hidden,)}``, the
+        input must be pre-extended with boundary context frames by the
+        caller (``pipelines.deepspeech2.StreamingDS2`` owns that math) and
+        the conv runs VALID instead of SAME."""
+        streaming = carry is not None or return_carry
+        if streaming and self.bidirectional:
+            raise ValueError("streaming requires bidirectional=False")
         B, T, F = x.shape
         h = x[..., None]                                  # (B, T, F, 1)
         # conv front-end: stride 2 in time halves T (DS2 conv1 11x13-ish
         # receptive field adapted to the 13-mel input)
+        pad = ((0, 0), (0, 0)) if streaming else ((5, 5), (0, 0))
         h = nn.Conv(self.conv_channels, (11, self.n_mels), strides=(2, 1),
-                    padding=((5, 5), (0, 0)), name="conv1")(h)
+                    padding=pad, name="conv1")(h)
         h = SequenceBN(name="bn_conv1")(h.reshape(B, h.shape[1], -1),
                                         train=train)
         h = jnp.clip(h, 0.0, 20.0)                        # clipped ReLU
+        new_h = []
         for i in range(self.n_rnn_layers):
             # per-layer input projection (the identity-i2h trick,
             # ``RNN.scala:28``): one MXU matmul over the whole sequence,
             # then the scan applies only the h2h recurrence
             h = nn.Dense(self.hidden, name=f"proj{i}")(h)
             h = SequenceBN(name=f"bn_rnn{i}")(h, train=train)
-            h = BiRecurrent(
-                cell=RnnCell(hidden_size=self.hidden, identity_input=True,
-                             activation="clipped_relu"),
-                merge="sum", name=f"birnn{i}")(h)
+            cell = RnnCell(hidden_size=self.hidden, identity_input=True,
+                           activation="clipped_relu")
+            if self.bidirectional:
+                h = BiRecurrent(cell=cell, merge="sum", name=f"birnn{i}")(h)
+            else:
+                h0 = carry["h"][i] if carry is not None else None
+                h, hN = Recurrent(cell=cell, name=f"rnn{i}")(
+                    h, carry0=h0, return_carry=True)
+                new_h.append(hN)
         h = SequenceBN(name="bn_out")(h, train=train)
         logits = nn.Dense(self.n_alphabet, name="fc_out")(h)
-        return jax.nn.log_softmax(logits, axis=-1)
+        out = jax.nn.log_softmax(logits, axis=-1)
+        if return_carry:
+            return out, {"h": tuple(new_h)}
+        return out
 
 
 def sequence_parallel_forward(variables, x, mesh,

@@ -232,3 +232,181 @@ def train_ds2(model: Model, dataset, epochs: int = 10, lr: float = 3e-4,
     if checkpoint_path:
         opt.set_checkpoint(checkpoint_path, Trigger.every_epoch())
     return opt.optimize()
+
+
+class StreamingDS2:
+    """Stateful streaming ASR: feed successive sample chunks, get
+    incremental transcript pieces — net-new over the reference, whose only
+    long-audio mechanism processes chunks INDEPENDENTLY with zeroed
+    context (``TimeSegmenter.scala:11``).
+
+    Exactness contract: the emitted log-probs exactly equal the batch
+    forward of the same (unidirectional) model over the whole utterance,
+    because every boundary carries its true state:
+
+    - featurization: a 240-sample window-overlap residue carries across
+      chunks, so frames are identical to whole-utterance framing;
+    - conv front-end (kernel 11, stride 2, SAME(5,5) in batch mode): the
+      stream starts with 5 zero context frames (= the left SAME pad),
+      carries the last 9 real mel frames between chunks, and ``flush()``
+      appends the 5-zero right pad; the model runs the conv VALID on the
+      extended chunk, so output indices line up exactly;
+    - RNN layers: forward-only scan with hidden state carried across
+      chunks (``DeepSpeech2(bidirectional=False)``);
+    - decoding: greedy CTC with the collapse state (previous argmax id)
+      carried, so repeats spanning a boundary collapse correctly.
+
+    Compilation: chunks are processed in FIXED ``chunk_frames`` blocks
+    (remainder buffered) so the jitted forward compiles exactly three
+    shapes — first block, steady block, and the padded flush block (flush
+    pads to the steady shape and truncates emissions to the true
+    remaining count, which keeps the tail exact for any stream length).
+
+    Latency: ``chunk_frames`` mel frames (10 ms each) of buffering plus
+    the conv's inherent 5-input-frame lookahead.
+    """
+
+    _CTX = 9            # real mel frames carried between blocks
+    _PAD = 5            # zero frames standing in for SAME padding at ends
+
+    def __init__(self, model: Model, n_mels: int = 13,
+                 chunk_frames: int = 100, keep_log_probs: bool = False):
+        import jax
+
+        if getattr(model.module, "bidirectional", True):
+            raise ValueError("streaming needs DeepSpeech2(bidirectional="
+                             "False) — the backward pass needs the future")
+        if chunk_frames < 6 or chunk_frames % 2:
+            raise ValueError("chunk_frames must be even and >= 6")
+        self.model = model
+        self.n_mels = n_mels
+        self.chunk_frames = chunk_frames
+        # retain emitted per-frame log-probs (exactness testing / lattice
+        # consumers); unbounded for endless streams, so off by default
+        self.keep_log_probs = keep_log_probs
+        self._apply = jax.jit(lambda v, x, c: model.module.apply(
+            v, x, carry=c, return_carry=True))
+        self._hidden = model.module.hidden
+        self._layers = model.module.n_rnn_layers
+        from analytics_zoo_tpu.transform.audio.featurize import (
+            WINDOW_SIZE, mel_filterbank_matrix)
+        self._fb = mel_filterbank_matrix(n_mels, WINDOW_SIZE)
+        self.reset()
+
+    def reset(self) -> None:
+        self._samples = np.zeros((0,), np.float32)
+        self._frames = np.zeros((0, self.n_mels), np.float32)
+        self._ctx: Optional[np.ndarray] = None     # None = stream start
+        self._h = {"h": tuple(
+            jnp.zeros((1, self._hidden)) for _ in range(self._layers))}
+        self._prev_id = 0                          # CTC collapse carry
+        self._pieces: List[str] = []
+        self._log_probs: List[np.ndarray] = []
+        self._total_frames = 0                     # real mel frames seen
+        self._emitted = 0                          # output frames emitted
+        self._finished = False
+
+    # -- internals ---------------------------------------------------------
+    def _featurize_new(self, samples: np.ndarray) -> np.ndarray:
+        """Consume buffered samples into mel frames, keeping the
+        window-overlap residue (window 400, stride 160 → 240 overlap)."""
+        from analytics_zoo_tpu.transform.audio.featurize import (
+            WINDOW_SIZE, WINDOW_STRIDE, dft_specgram, frame_signal,
+            mel_features)
+
+        self._samples = np.concatenate([self._samples, samples])
+        n = max((len(self._samples) - WINDOW_SIZE) // WINDOW_STRIDE + 1, 0)
+        if n == 0:
+            return np.zeros((0, self.n_mels), np.float32)
+        take = WINDOW_SIZE + WINDOW_STRIDE * (n - 1)
+        frames = frame_signal(self._samples[:take])
+        self._samples = self._samples[WINDOW_STRIDE * n:]
+        return mel_features(dft_specgram(frames), n_mels=self.n_mels,
+                            fb=self._fb)
+
+    def _run(self, ext: np.ndarray, n_emit: Optional[int] = None) -> str:
+        log_probs, self._h = self._apply(
+            self.model.variables, jnp.asarray(ext[None]), self._h)
+        lp = np.asarray(log_probs[0])
+        if n_emit is not None:
+            lp = lp[:n_emit]
+        self._emitted += lp.shape[0]
+        if self.keep_log_probs:
+            self._log_probs.append(lp)
+        return self._decode(lp)
+
+    def _update_ctx(self, real_frames: np.ndarray) -> None:
+        """ctx = last 9 REAL frames of the stream (zero-left-padded while
+        fewer have been seen)."""
+        prev = (self._ctx if self._ctx is not None
+                else np.zeros((self._CTX, self.n_mels), np.float32))
+        self._ctx = np.concatenate([prev, real_frames])[-self._CTX:]
+
+    def _decode(self, log_probs: np.ndarray) -> str:
+        out = []
+        for t in np.argmax(log_probs, axis=-1):
+            if t != self._prev_id and t != 0:
+                out.append(ALPHABET[int(t)])
+            self._prev_id = int(t)
+        piece = "".join(out)
+        self._pieces.append(piece)
+        return piece
+
+    # -- public API --------------------------------------------------------
+    def accept(self, samples: np.ndarray) -> str:
+        """Feed raw samples; returns the transcript piece decoded from any
+        completed fixed-size frame blocks (possibly "")."""
+        if self._finished:
+            raise RuntimeError("stream finished — call reset() first")
+        frames = self._featurize_new(np.asarray(samples, np.float32))
+        if frames.shape[0]:
+            self._frames = np.concatenate([self._frames, frames])
+            self._total_frames += frames.shape[0]
+        pieces = []
+        C = self.chunk_frames
+        while self._frames.shape[0] >= C:
+            chunk, self._frames = self._frames[:C], self._frames[C:]
+            if self._ctx is None:
+                ext = np.concatenate(
+                    [np.zeros((self._PAD, self.n_mels), np.float32), chunk])
+            else:
+                ext = np.concatenate([self._ctx, chunk])
+            self._update_ctx(chunk)
+            pieces.append(self._run(ext))
+        return "".join(pieces)
+
+    def flush(self) -> str:
+        """End of stream: process buffered frames + the right SAME pad,
+        padded up to the steady block shape (emissions truncated to the
+        true remaining count, so the tail stays exact)."""
+        if self._finished:
+            return ""
+        self._finished = True
+        r = self._frames.shape[0]
+        virgin = self._ctx is None
+        ctx = (np.zeros((self._PAD, self.n_mels), np.float32) if virgin
+               else self._ctx)
+        # ONE flush shape regardless of remainder size or virginity:
+        # r <= C-1 (accept drains full blocks) and ctx is 5 or 9 frames,
+        # so pad >= PAD always holds
+        target = self.chunk_frames + self._CTX + self._PAD
+        pad = target - ctx.shape[0] - r
+        assert pad >= self._PAD, (pad, r)
+        ext = np.concatenate([
+            ctx, self._frames,
+            np.zeros((pad, self.n_mels), np.float32)])
+        self._frames = np.zeros((0, self.n_mels), np.float32)
+        expected_total = (self._total_frames + 1) // 2
+        n_emit = max(expected_total - self._emitted, 0)
+        return self._run(ext, n_emit=n_emit) if n_emit else ""
+
+    @property
+    def transcript(self) -> str:
+        return "".join(self._pieces)
+
+    @property
+    def log_probs(self) -> np.ndarray:
+        """Concatenated emitted log-probs (requires keep_log_probs)."""
+        if not self._log_probs:
+            return np.zeros((0, 0), np.float32)
+        return np.concatenate(self._log_probs, axis=0)

@@ -1,0 +1,141 @@
+"""Streaming DS2 (pipelines/deepspeech2.StreamingDS2): chunked stateful
+inference must EXACTLY match the whole-utterance batch forward of the same
+unidirectional model — featurization residue, conv boundary context, RNN
+hidden state, and the CTC collapse state all carried across chunks.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.core.module import Model
+from analytics_zoo_tpu.models import DeepSpeech2
+from analytics_zoo_tpu.pipelines.deepspeech2 import StreamingDS2
+from analytics_zoo_tpu.transform.audio import best_path_decode, featurize
+
+
+def _uni_model(hidden=32, layers=2):
+    m = Model(DeepSpeech2(hidden=hidden, n_rnn_layers=layers,
+                          bidirectional=False))
+    m.build(0, jnp.zeros((1, 50, 13), jnp.float32))
+    return m
+
+
+def _batch_logprobs(model, samples):
+    feats = featurize(samples)
+    return np.asarray(model.module.apply(
+        model.variables, jnp.asarray(feats[None])))[0], feats
+
+
+class TestStreamingParity:
+    @pytest.mark.parametrize("chunk_sizes", [
+        [16000, 16000],                       # regular 1s chunks
+        [3000, 7000, 12000, 5000, 5000],      # irregular
+        [400, 1600, 30000],                   # tiny first feed
+    ])
+    def test_logprob_parity_with_batch(self, chunk_sizes):
+        rng = np.random.RandomState(0)
+        total = sum(chunk_sizes)
+        samples = (rng.randn(total) * 0.1).astype(np.float32)
+        model = _uni_model()
+
+        ref, feats = _batch_logprobs(model, samples)
+
+        stream = StreamingDS2(model, keep_log_probs=True)
+        pos = 0
+        for c in chunk_sizes:
+            stream.accept(samples[pos:pos + c])
+            pos += c
+        stream.flush()
+        got = stream.log_probs
+        # exact: streaming emits precisely the batch frames
+        assert got.shape == ref.shape, (got.shape, ref.shape)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_transcript_matches_batch_decode(self):
+        rng = np.random.RandomState(1)
+        samples = (rng.randn(48000) * 0.1).astype(np.float32)
+        model = _uni_model()
+        ref, _ = _batch_logprobs(model, samples)
+
+        stream = StreamingDS2(model, keep_log_probs=True)
+        for k in range(0, 48000, 5000):
+            stream.accept(samples[k:k + 5000])
+        stream.flush()
+        assert stream.log_probs.shape[0] == ref.shape[0]
+        assert stream.transcript == best_path_decode(ref)
+
+    def test_reset_reuses_model(self):
+        rng = np.random.RandomState(2)
+        model = _uni_model()
+        s1 = (rng.randn(16000) * 0.1).astype(np.float32)
+        stream = StreamingDS2(model, keep_log_probs=True)
+        stream.accept(s1)
+        stream.flush()
+        t1, lp1 = stream.transcript, stream.log_probs
+        stream.reset()
+        stream.accept(s1)
+        stream.flush()
+        assert stream.transcript == t1
+        np.testing.assert_allclose(stream.log_probs, lp1)
+
+    def test_bidirectional_rejected(self):
+        m = Model(DeepSpeech2(hidden=16, n_rnn_layers=1))
+        m.build(0, jnp.zeros((1, 50, 13), jnp.float32))
+        with pytest.raises(ValueError, match="bidirectional"):
+            StreamingDS2(m)
+
+
+class TestUnidirectionalModel:
+    def test_streaming_carry_shapes(self):
+        model = _uni_model(hidden=16, layers=1)
+        x = jnp.zeros((1, 20, 13))
+        carry = {"h": (jnp.zeros((1, 16)),)}
+        out, new_carry = model.module.apply(model.variables, x, carry=carry,
+                                            return_carry=True)
+        assert out.shape[0] == 1 and out.shape[2] == 29
+        assert new_carry["h"][0].shape == (1, 16)
+
+    def test_streaming_mode_needs_unidirectional(self):
+        m = Model(DeepSpeech2(hidden=16, n_rnn_layers=1))
+        m.build(0, jnp.zeros((1, 50, 13), jnp.float32))
+        with pytest.raises(ValueError, match="bidirectional"):
+            m.module.apply(m.variables, jnp.zeros((1, 20, 13)),
+                           return_carry=True)
+
+
+class TestStreamGuards:
+    def test_accept_after_flush_raises(self):
+        model = _uni_model(hidden=16, layers=1)
+        stream = StreamingDS2(model)
+        stream.accept(np.zeros(16000, np.float32))
+        stream.flush()
+        with pytest.raises(RuntimeError, match="reset"):
+            stream.accept(np.zeros(1000, np.float32))
+        assert stream.flush() == ""          # idempotent
+
+    def test_chunk_frames_validated(self):
+        model = _uni_model(hidden=16, layers=1)
+        with pytest.raises(ValueError, match="even"):
+            StreamingDS2(model, chunk_frames=7)
+        with pytest.raises(ValueError, match="even"):
+            StreamingDS2(model, chunk_frames=4)
+
+    def test_fixed_block_shapes(self):
+        """At most 3 distinct jitted shapes: first block, steady, flush."""
+        model = _uni_model(hidden=16, layers=1)
+        stream = StreamingDS2(model, chunk_frames=20)
+        shapes = []
+        orig = stream._apply
+
+        def spy(v, x, c):
+            shapes.append(x.shape)
+            return orig(v, x, c)
+
+        stream._apply = spy
+        rng = np.random.RandomState(3)
+        for c in (5000, 9000, 20000, 3000, 12000):
+            stream.accept((rng.randn(c) * 0.1).astype(np.float32))
+        stream.flush()
+        assert len(set(shapes)) <= 3, set(shapes)
