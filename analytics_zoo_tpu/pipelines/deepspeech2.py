@@ -49,6 +49,10 @@ class DS2Param:
     # featurize (window → rFFT → mel) on device as one jitted batch
     # program instead of per-segment host numpy (SURVEY.md §3.4 hot loop)
     device_featurize: bool = True
+    # 'greedy' (reference BestPathDecoder) | 'beam' (prefix beam search —
+    # sums alignment mass per prefix; net-new over the reference)
+    decoder: str = "greedy"
+    beam_width: int = 16
 
     @property
     def utt_length(self) -> int:
@@ -126,6 +130,13 @@ class DeepSpeech2Pipeline:
                 self._dev_featurizer(batch, n_valid))[:len(chunk)]
         return out
 
+    def _decode(self, log_probs: np.ndarray) -> str:
+        if self.param.decoder == "beam":
+            from analytics_zoo_tpu.transform.audio import beam_search_decode
+            return beam_search_decode(log_probs,
+                                      beam_width=self.param.beam_width)
+        return best_path_decode(log_probs)
+
     def transcribe_samples(self, utterances: Dict[str, np.ndarray]
                            ) -> Dict[str, str]:
         """{audio_id: samples} → {audio_id: transcript}."""
@@ -154,7 +165,7 @@ class DeepSpeech2Pipeline:
                 chunk = np.concatenate([chunk, pad])
             log_probs = self._eval_step(self.model.variables,
                                         jnp.asarray(chunk))
-            texts.extend(best_path_decode(np.asarray(log_probs[j]))
+            texts.extend(self._decode(np.asarray(log_probs[j]))
                          for j in range(n_real))
 
         # re-join by (audio_id, audio_seq) (reference InferenceEvaluate

@@ -21,6 +21,7 @@ from analytics_zoo_tpu.transform.audio.decoders import (
     NGramDecoder,
     TranscriptVectorizer,
     VocabDecoder,
+    beam_search_decode,
     best_path_decode,
     cer,
     levenshtein,

@@ -182,3 +182,90 @@ def test_ds2_pipeline_device_featurize_parity():
     out_d = DeepSpeech2Pipeline(model, param_d).transcribe_samples(utts)
     out_h = DeepSpeech2Pipeline(model, param_h).transcribe_samples(utts)
     assert out_d == out_h
+
+
+class TestBeamSearchDecode:
+    @staticmethod
+    def _brute_force(log_probs, alphabet, blank_id=0):
+        """Enumerate ALL alignments, sum per collapsed string — exact
+        CTC decoding oracle for tiny T and vocab."""
+        import itertools
+
+        T, V = log_probs.shape
+        totals = {}
+        for path in itertools.product(range(V), repeat=T):
+            lp = sum(log_probs[t, s] for t, s in enumerate(path))
+            out, prev = [], -1
+            for s in path:
+                if s != prev and s != blank_id:
+                    out.append(alphabet[s])
+                prev = s
+            key = "".join(out)
+            totals[key] = np.logaddexp(totals.get(key, -np.inf), lp)
+        return max(totals, key=totals.get)
+
+    def test_matches_brute_force(self):
+        from analytics_zoo_tpu.transform.audio import beam_search_decode
+
+        rng = np.random.RandomState(0)
+        alphabet = "_AB"
+        for trial in range(20):
+            logits = rng.randn(4, 3).astype(np.float32) * 2
+            lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+            got = beam_search_decode(lp, beam_width=64, alphabet=alphabet,
+                                     prune_log_prob=-1e9)
+            want = self._brute_force(lp, alphabet)
+            assert got == want, (trial, got, want, lp)
+
+    def test_beats_greedy_on_split_mass(self):
+        """The canonical case: argmax path is blank-heavy but summed
+        alignment mass favors a character."""
+        from analytics_zoo_tpu.transform.audio import (beam_search_decode,
+                                                       best_path_decode)
+
+        alphabet = "_AB"
+        # each frame: blank 0.4, A 0.35, B 0.25 -> greedy = "" (all blank)
+        p = np.log(np.asarray([[0.4, 0.35, 0.25]] * 2, np.float32))
+        greedy = best_path_decode(p, alphabet=alphabet)
+        beam = beam_search_decode(p, beam_width=8, alphabet=alphabet,
+                                  prune_log_prob=-1e9)
+        assert greedy == ""
+        # P("") = .16; P("A") = .35*.4*2 + .35*.35 = .4025 -> "A" wins
+        assert beam == "A"
+        assert beam == self._brute_force(p, alphabet)
+
+    def test_repeat_handling(self):
+        from analytics_zoo_tpu.transform.audio import beam_search_decode
+
+        alphabet = "_AB"
+        # A A with certainty collapses to "A"; A _ A stays "AA"
+        certain_aa = np.log(np.asarray(
+            [[.01, .98, .01], [.01, .98, .01]], np.float32))
+        assert beam_search_decode(certain_aa, alphabet=alphabet) == "A"
+        a_blank_a = np.log(np.asarray(
+            [[.01, .98, .01], [.98, .01, .01], [.01, .98, .01]], np.float32))
+        assert beam_search_decode(a_blank_a, alphabet=alphabet) == "AA"
+
+    def test_default_alphabet_runs(self):
+        from analytics_zoo_tpu.transform.audio import beam_search_decode
+
+        rng = np.random.RandomState(1)
+        logits = rng.randn(50, 29).astype(np.float32)
+        lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        out = beam_search_decode(lp, beam_width=8)
+        assert isinstance(out, str)
+
+    def test_pipeline_beam_decoder_option(self):
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.pipelines.deepspeech2 import (
+            DS2Param, DeepSpeech2Pipeline, make_ds2_model)
+
+        rng = np.random.RandomState(2)
+        param = DS2Param(segment_seconds=1, batch_size=2, decoder="beam",
+                         beam_width=4)
+        model = make_ds2_model(hidden=16, n_rnn_layers=1,
+                               utt_length=param.utt_length)
+        out = DeepSpeech2Pipeline(model, param).transcribe_samples(
+            {"a": rng.randn(16000).astype(np.float32) * 0.1})
+        assert isinstance(out["a"], str)
