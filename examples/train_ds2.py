@@ -104,32 +104,40 @@ def main():
     train_ds2(model, batches, epochs=args.epochs, lr=args.lr,
               checkpoint_path=args.checkpoint)
 
-    # held-out eval: greedy-decode unseen synthetic utterances and score
-    # token-level edit distance (the ASREvaluator CER machinery)
+    # held-out eval: decode unseen synthetic utterances with BOTH the
+    # greedy and prefix-beam decoders, score token-level edit distance
+    # (the ASREvaluator CER machinery)
     import json
 
     import jax
 
-    from analytics_zoo_tpu.transform.audio import best_path_decode
+    from analytics_zoo_tpu.transform.audio import (beam_search_decode,
+                                                   best_path_decode)
     from analytics_zoo_tpu.transform.audio.decoders import levenshtein
 
-    total_ed = total_len = exact = n_seq = 0
+    stats = {"greedy": [0, 0], "beam": [0, 0]}   # edit distance, exact
+    total_len = n_seq = 0
     for hb in heldout:
         log_probs = model.forward(hb["input"])
         for i in range(hb["input"].shape[0]):
             ref = "".join(ALPHABET[t] for t in hb["labels"][i]
                           if t > 0)
-            hyp = best_path_decode(np.asarray(log_probs[i]))
-            total_ed += levenshtein(hyp, ref)
+            lp = np.asarray(log_probs[i])
+            for name, hyp in (("greedy", best_path_decode(lp)),
+                              ("beam", beam_search_decode(lp))):
+                stats[name][0] += levenshtein(hyp, ref)
+                stats[name][1] += int(hyp == ref)
             total_len += max(len(ref), 1)
-            exact += int(hyp == ref)
             n_seq += 1
     cer_field = ("train_set_cer" if heldout_is_train else "cer")
+    g, b = stats["greedy"], stats["beam"]
     report = {
         "task": ("LibriSpeech-style dir" if args.data_dir
                  else "synthetic tone→token CTC (held-out)"),
-        cer_field: round(total_ed / max(total_len, 1), 4),
-        "exact_sequence_acc": round(exact / max(n_seq, 1), 4),
+        cer_field: round(g[0] / max(total_len, 1), 4),
+        "exact_sequence_acc": round(g[1] / max(n_seq, 1), 4),
+        "beam_" + cer_field: round(b[0] / max(total_len, 1), 4),
+        "beam_exact_sequence_acc": round(b[1] / max(n_seq, 1), 4),
         "sequences": n_seq,
         "epochs": args.epochs,
         "backend": jax.default_backend(),
