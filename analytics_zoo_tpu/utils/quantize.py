@@ -134,6 +134,65 @@ def make_quantized_forward(module, dtype=None,
     return fwd
 
 
+def save_quantized_npz(path: str, qparams: Any) -> str:
+    """Persist a (possibly quantized) variables pytree as one npz file —
+    the serving artifact format (``tools/export_serving.py``): QTensors
+    become ``<path>#q`` (int8) + ``<path>#scale`` pairs, plain leaves
+    ``<path>#raw``.  Returns the actual file path (np.savez appends
+    ``.npz`` when missing — normalized here so save/load stay inverses)."""
+    if not path.endswith(".npz"):
+        path += ".npz"
+    flat: dict = {}
+
+    def rec(prefix: str, node: Any) -> None:
+        if isinstance(node, QTensor):
+            flat[prefix + "#q"] = np.asarray(node.q)
+            flat[prefix + "#scale"] = np.asarray(node.scale)
+        elif hasattr(node, "items"):
+            for k, v in node.items():
+                if "#" in str(k) or "/" in str(k):
+                    raise ValueError(f"key {k!r} contains a reserved char")
+                rec(f"{prefix}/{k}" if prefix else str(k), v)
+        else:
+            flat[prefix + "#raw"] = np.asarray(node)
+
+    rec("", qparams)
+    np.savez_compressed(path, **flat)
+    return path
+
+
+def load_quantized_npz(path: str) -> Any:
+    """Inverse of :func:`save_quantized_npz`: nested dict pytree with
+    QTensor leaves restored, ready for :func:`make_quantized_forward`."""
+    import jax.numpy as jnp
+
+    data = np.load(path)
+    out: dict = {}
+    pending: dict = {}
+    for key in data.files:
+        name, kind = key.rsplit("#", 1)
+        if kind in ("q", "scale"):
+            pending.setdefault(name, {})[kind] = data[key]
+            continue
+        if name == "":                       # bare-leaf root
+            return jnp.asarray(data[key])
+        parts = name.split("/")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(data[key])
+    for name, qs in pending.items():
+        qt = QTensor(jnp.asarray(qs["q"]), jnp.asarray(qs["scale"]))
+        if name == "":                       # bare-QTensor root
+            return qt
+        parts = name.split("/")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = qt
+    return out
+
+
 def quantized_nbytes(tree: Any) -> Tuple[int, int]:
     """(quantized_bytes, fp32_equivalent_bytes) across the pytree."""
     qb = fb = 0

@@ -167,3 +167,57 @@ class TestQuantizedPredictor:
         ref = np.asarray(m.apply(variables, x))
         assert np.abs(np.asarray(out) - ref).max() < 0.1 * (
             np.abs(ref).max() + 1e-6)
+
+
+class TestServingArtifact:
+    def test_npz_roundtrip(self, tmp_path):
+        from analytics_zoo_tpu.utils.quantize import (load_quantized_npz,
+                                                      save_quantized_npz)
+
+        m = nn.Sequential([nn.Dense(256), nn.relu, nn.Dense(8)])
+        variables = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 64)))
+        q = quantize_params(variables, min_size=1024)
+        path = str(tmp_path / "art.npz")
+        save_quantized_npz(path, q)
+        back = load_quantized_npz(path)
+
+        x = jnp.asarray(np.random.RandomState(6).randn(2, 64), jnp.float32)
+        fwd = make_quantized_forward(m)
+        np.testing.assert_allclose(np.asarray(fwd(back, x)),
+                                   np.asarray(fwd(q, x)),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_export_cli_end_to_end(self, tmp_path):
+        import subprocess
+        import sys as _sys
+
+        from analytics_zoo_tpu.core.module import Model
+        from analytics_zoo_tpu.models import DeepSpeech2
+
+        m = Model(DeepSpeech2(hidden=64))
+        m.build(0, jnp.zeros((1, 100, 13), jnp.float32))
+        import os as _os
+        repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+        model_file = str(tmp_path / "m.flax")
+        m.save(model_file)
+        out = str(tmp_path / "m_int8.npz")
+        env = dict(_os.environ, AZ_PLATFORM="cpu", PYTHONPATH=repo)
+        r = subprocess.run(
+            [_sys.executable, _os.path.join(repo, "tools/export_serving.py"),
+             "--model-file", model_file, "--arch", "ds2", "--hidden", "64",
+             "--out", out, "--verify"],
+            capture_output=True, text=True, env=env)
+        assert r.returncode == 0, r.stderr[-800:]
+        assert "verify: max abs err" in r.stdout
+
+    def test_npz_suffix_normalized_and_root_leaf(self, tmp_path):
+        from analytics_zoo_tpu.utils.quantize import (load_quantized_npz,
+                                                      save_quantized_npz)
+
+        qt = quantize_tensor(np.random.RandomState(7)
+                             .randn(64, 64).astype(np.float32))
+        p = save_quantized_npz(str(tmp_path / "noext"), qt)
+        assert p.endswith(".npz")
+        back = load_quantized_npz(p)
+        assert isinstance(back, QTensor)
+        np.testing.assert_array_equal(np.asarray(back.q), np.asarray(qt.q))
