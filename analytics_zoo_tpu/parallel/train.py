@@ -121,6 +121,7 @@ def make_train_step(
     grad_clip_norm: Optional[float] = None,
     skip_loss_above: Optional[float] = None,
     compute_dtype=None,
+    grad_accum: int = 1,
 ):
     """Build the jitted train step.
 
@@ -137,6 +138,14 @@ def make_train_step(
     default ``loss_scale=1.0`` is safe (unlike fp16); the scale hook stays
     plumbed for experimentation.  This replaces the reference's MKL-tuned
     kernels as the fast-kernel story (``pipeline/ssd/pom.xml:73-83``).
+
+    ``grad_accum=N`` splits the batch into N microbatches and accumulates
+    their gradients with a ``lax.scan`` inside the SAME jitted step —
+    activation memory drops ~N× (large effective batches on one chip)
+    while the update equals the full-batch step exactly for mean-reduced
+    losses.  BatchNorm running stats are chained through the N
+    microbatches sequentially (the EMA advances N times per step — same
+    data seen, faster-moving stats than a single full-batch update).
     """
 
     cdtype = resolve_compute_dtype(compute_dtype)
@@ -159,11 +168,56 @@ def make_train_step(
         loss = _call_criterion(criterion, output, batch)
         return loss * loss_scale, (new_model_state, loss)
 
+    def _grads(params, model_state, batch, rng):
+        """(grads, model_state, loss) — single-shot or scan-accumulated."""
+        if grad_accum <= 1:
+            g, (ms, loss) = jax.grad(loss_fn, has_aux=True)(
+                params, model_state, batch, rng)
+            return g, ms, loss
+        # every batch leaf must be batch-major with the SAME dim 0,
+        # divisible by grad_accum — a silent reshape of a shared (non-
+        # batch) leaf would feed each microbatch a slice of it
+        sizes = {getattr(leaf, "shape", (None,))[0] if getattr(
+            leaf, "ndim", 0) > 0 else None
+            for leaf in jax.tree_util.tree_leaves(batch)}
+        if None in sizes or len(sizes) != 1:
+            raise ValueError(
+                f"grad_accum needs batch-major array leaves with one "
+                f"common dim 0, got leading dims {sizes}")
+        (B,) = sizes
+        if B % grad_accum:
+            raise ValueError(f"batch size {B} not divisible by "
+                             f"grad_accum={grad_accum} (pad or "
+                             f"drop_remainder the tail batch)")
+        micro = jax.tree_util.tree_map(
+            lambda x: x.reshape((grad_accum, B // grad_accum) + x.shape[1:]),
+            batch)
+
+        # only the mutable collection rides the scan carry — constant
+        # collections in model_state would mismatch the returned structure
+        mut0 = ({"batch_stats": model_state["batch_stats"]}
+                if "batch_stats" in model_state else {})
+
+        def body(carry, inp):
+            g_acc, loss_acc, mut = carry
+            mb, j = inp
+            g, (new_mut, l) = jax.grad(loss_fn, has_aux=True)(
+                params, {**model_state, **mut}, mb,
+                jax.random.fold_in(rng, j))
+            g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+            return (g_acc, loss_acc + l, new_mut), None
+
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (g_sum, loss_sum, mut), _ = jax.lax.scan(
+            body, (zeros, 0.0, mut0), (micro, jnp.arange(grad_accum)))
+        inv = 1.0 / grad_accum
+        return (jax.tree_util.tree_map(lambda g: g * inv, g_sum),
+                mut, loss_sum * inv)
+
     def step_fn(state: TrainState, batch, lr_scale):
         rng, new_rng = jax.random.split(jax.random.fold_in(state.rng, state.step))
-        grads, (new_model_state, loss) = jax.grad(
-            loss_fn, has_aux=True
-        )(state.params, state.model_state, batch, rng)
+        grads, new_model_state, loss = _grads(
+            state.params, state.model_state, batch, rng)
         if loss_scale != 1.0:
             grads = jax.tree_util.tree_map(lambda g: g / loss_scale, grads)
         gnorm = optax.global_norm(grads) if grad_clip_norm else None
@@ -324,7 +378,8 @@ class Optimizer:
                  skip_loss_above: Optional[float] = None,
                  grad_clip_norm: Optional[float] = None,
                  compute_dtype=None, device_transform=None,
-                 param_rules=None, prefetch: int = 0):
+                 param_rules=None, prefetch: int = 0,
+                 grad_accum: int = 1):
         self.model = model
         self.dataset = dataset
         self.criterion = criterion
@@ -352,6 +407,8 @@ class Optimizer:
         # `prefetch` ahead of the device (data.prefetch double-buffering,
         # SURVEY.md §3.1 HOT LOOP #1 overlap)
         self.prefetch = prefetch
+        # > 1: accumulate gradients over N microbatches inside the step
+        self.grad_accum = grad_accum
         self._score_name: Optional[str] = None
         self.resume_path: Optional[str] = None
         self._resume_requested = False
@@ -425,6 +482,7 @@ class Optimizer:
             mesh=self.mesh, skip_loss_above=self.skip_loss_above,
             grad_clip_norm=self.grad_clip_norm,
             compute_dtype=self.compute_dtype,
+            grad_accum=self.grad_accum,
         )
         eval_step = make_eval_step(self.model.module,
                                    compute_dtype=self.compute_dtype)

@@ -13,8 +13,10 @@ from analytics_zoo_tpu.pipelines.frame import (
     time_ordered_split,
 )
 from analytics_zoo_tpu.pipelines.evaluation import (
+    CocoMeanAveragePrecision,
     DetectionResult,
     MeanAveragePrecision,
+    MultiIoUResult,
     PascalVocEvaluator,
     mark_tp_fp,
     voc_ap,

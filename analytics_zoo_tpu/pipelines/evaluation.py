@@ -176,6 +176,132 @@ class MeanAveragePrecision:
         return res
 
 
+def _iou_matrix(det_boxes: np.ndarray, gt_boxes: np.ndarray,
+                normalized: bool) -> np.ndarray:
+    d = np.asarray(det_boxes, np.float64)
+    g = np.asarray(gt_boxes, np.float64)
+    off = 0.0 if normalized else 1.0
+    ix1 = np.maximum(d[:, None, 0], g[None, :, 0])
+    iy1 = np.maximum(d[:, None, 1], g[None, :, 1])
+    ix2 = np.minimum(d[:, None, 2], g[None, :, 2])
+    iy2 = np.minimum(d[:, None, 3], g[None, :, 3])
+    inter = (np.maximum(ix2 - ix1 + off, 0) * np.maximum(iy2 - iy1 + off, 0))
+    area_d = (d[:, 2] - d[:, 0] + off) * (d[:, 3] - d[:, 1] + off)
+    area_g = (g[:, 2] - g[:, 0] + off) * (g[:, 3] - g[:, 1] + off)
+    return inter / np.maximum(area_d[:, None] + area_g[None, :] - inter,
+                              1e-12)
+
+
+def mark_tp_fp_multi(det_boxes: np.ndarray, det_scores: np.ndarray,
+                     gt_boxes: np.ndarray, gt_difficult: np.ndarray,
+                     thresholds: Sequence[float],
+                     normalized: bool = True) -> List[np.ndarray]:
+    """COCO-convention matching at several IoU thresholds sharing ONE IoU
+    matrix + score sort: each detection (score desc) matches the
+    HIGHEST-IoU still-unmatched non-difficult gt with IoU ≥ t (pycocotools
+    semantics — NOT the VOC argmax-only rule of :func:`mark_tp_fp`, which
+    marks a duplicate FP even when another gt would match).  Difficult
+    (COCO "ignore") gts absorb otherwise-unmatched detections.
+
+    Returns one (N, 3) (score, tp, fp) array per threshold.
+    """
+    order = np.argsort(-np.asarray(det_scores))
+    n_det, n_gt = len(det_boxes), len(gt_boxes)
+    iou = (_iou_matrix(det_boxes, gt_boxes, normalized) if n_gt
+           else np.zeros((n_det, 0)))
+    diff = np.asarray(gt_difficult) > 0
+    outs = []
+    for t in thresholds:
+        out = np.zeros((n_det, 3), np.float32)
+        taken = np.zeros(n_gt, bool)
+        for row, i in enumerate(order):
+            out[row, 0] = det_scores[i]
+            cand = ~taken & ~diff & (iou[i] >= t) if n_gt else np.zeros(0, bool)
+            if cand.any():
+                j = int(np.argmax(np.where(cand, iou[i], -1.0)))
+                taken[j] = True
+                out[row, 1] = 1.0                      # tp
+            elif n_gt and (diff & (iou[i] >= t)).any():
+                continue                               # ignore region
+            else:
+                out[row, 2] = 1.0                      # fp
+        outs.append(out)
+    return outs
+
+
+class MultiIoUResult:
+    """Monoid over per-IoU-threshold DetectionResults (COCO-style)."""
+
+    def __init__(self, results: List[DetectionResult],
+                 name: str = "mAP@[.5:.95]"):
+        self.results = results
+        self.name = name
+
+    def __add__(self, other: "MultiIoUResult") -> "MultiIoUResult":
+        return MultiIoUResult([a + b for a, b in
+                               zip(self.results, other.results)], self.name)
+
+    def result(self) -> float:
+        vals = [r.result() for r in self.results]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def per_threshold(self) -> List[float]:
+        return [r.result() for r in self.results]
+
+    def __repr__(self):
+        return f"{self.name}: {self.result():.4f}"
+
+
+class CocoMeanAveragePrecision:
+    """COCO-convention mAP averaged over IoU thresholds 0.50:0.05:0.95
+    with area-under-PR AP and pycocotools matching (best still-unmatched
+    gt, difficult = ignore region) — net-new over the reference, whose
+    COCO support stops at dataset ingestion + VOC-style eval
+    (``common/Coco.scala``, ``EvalUtil``).  Same batch interface as
+    :class:`MeanAveragePrecision`, so it plugs into ``parallel.validate``
+    / ``set_validation`` unchanged.  The per-image IoU matrix and score
+    sort are computed ONCE and shared across all thresholds.
+    """
+
+    def __init__(self, n_classes: int = 81, normalized: bool = True,
+                 class_names: Optional[Sequence[str]] = None,
+                 thresholds: Optional[Sequence[float]] = None):
+        self.thresholds = (list(thresholds) if thresholds is not None
+                           else [0.5 + 0.05 * i for i in range(10)])
+        self.n_classes = n_classes
+        self.normalized = normalized
+        self.class_names = class_names
+        self.name = "mAP@[.5:.95]"
+
+    def __call__(self, output, batch) -> MultiIoUResult:
+        dets = np.asarray(output)
+        target = batch["target"]
+        gt_boxes = np.asarray(target["bboxes"])
+        gt_labels = np.asarray(target["labels"])
+        gt_mask = np.asarray(target["mask"])
+        gt_diff = np.asarray(target.get("difficult", np.zeros_like(gt_mask)))
+        results = [DetectionResult(self.n_classes, use_07_metric=False,
+                                   class_names=self.class_names)
+                   for _ in self.thresholds]
+        for b in range(dets.shape[0]):
+            valid_gt = gt_mask[b] > 0
+            for c in range(1, self.n_classes):
+                cls_gt = valid_gt & (gt_labels[b] == c)
+                npos = int((cls_gt & (gt_diff[b] == 0)).sum())
+                for r in results:
+                    r.npos[c] += npos
+                sel = (dets[b, :, 0] == c) & (dets[b, :, 1] > 0)
+                if not sel.any():
+                    continue
+                marks = mark_tp_fp_multi(
+                    dets[b, sel, 2:6], dets[b, sel, 1],
+                    gt_boxes[b][cls_gt], gt_diff[b][cls_gt],
+                    self.thresholds, self.normalized)
+                for r, m in zip(results, marks):
+                    r.marks[c].append(m)
+        return MultiIoUResult(results, self.name)
+
+
 class PascalVocEvaluator:
     """Standalone evaluator with per-class AP printout (reference
     ``PascalVocEvaluator.scala:33``; metric picked by year: 2007 → 11-point)."""

@@ -168,3 +168,59 @@ def test_optimizer_prefetch_matches_sync():
         return np.asarray(m.forward(data[0]["input"]))
 
     np.testing.assert_allclose(run(0), run(2), rtol=1e-6, atol=1e-7)
+
+
+class TestGradAccumulation:
+    def _run(self, grad_accum, model_fn, batch, steps=3):
+        import jax
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.core.criterion import MSECriterion
+        from analytics_zoo_tpu.core.module import Model
+        from analytics_zoo_tpu.parallel import (SGD, create_train_state,
+                                                make_train_step)
+
+        m = Model(model_fn())
+        m.build(0, jnp.zeros((1,) + batch["input"].shape[1:], jnp.float32))
+        optim = SGD(0.05, momentum=0.9)
+        state = create_train_state(m, optim)
+        step = make_train_step(m.module, MSECriterion(), optim,
+                               grad_accum=grad_accum)
+        for _ in range(steps):
+            state, metrics = step(state, batch, 1.0)
+        return (jax.device_get(state.params),
+                float(metrics["loss"]))
+
+    def test_accum_matches_full_batch(self):
+        import numpy as np
+        from flax import linen as nn
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 8).astype(np.float32)
+        batch = {"input": x, "target": np.tanh(x @ rng.randn(8, 4)
+                                               ).astype(np.float32)}
+        p1, l1 = self._run(1, lambda: nn.Dense(4), batch)
+        p4, l4 = self._run(4, lambda: nn.Dense(4), batch)
+        assert abs(l1 - l4) < 1e-5
+        import jax
+        for a, b in zip(jax.tree_util.tree_leaves(p1),
+                        jax.tree_util.tree_leaves(p4)):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    def test_accum_with_batchnorm_runs(self):
+        import numpy as np
+        from flax import linen as nn
+
+        class BNNet(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=False):
+                h = nn.Dense(8)(x)
+                h = nn.BatchNorm(use_running_average=not train)(h)
+                return nn.Dense(4)(h)
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(16, 8).astype(np.float32)
+        batch = {"input": x,
+                 "target": rng.randn(16, 4).astype(np.float32)}
+        p, l = self._run(4, BNNet, batch)
+        assert np.isfinite(l)

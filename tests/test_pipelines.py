@@ -320,3 +320,89 @@ def test_ssd_map_validation_method_on_raw_output():
     assert 0.0 <= res.result() <= 1.0
     merged = res + m((loc, conf), batch)
     assert merged.npos[1] == 12
+
+
+class TestCocoMeanAveragePrecision:
+    @staticmethod
+    def _batch(gt_box, det_box, score=0.9):
+        output = np.zeros((1, 4, 6), np.float32)
+        output[0, 0] = [1, score] + list(det_box)
+        batch = {"target": {
+            "bboxes": np.asarray([[gt_box]], np.float32),
+            "labels": np.asarray([[1]], np.int32),
+            "mask": np.ones((1, 1), np.float32),
+        }}
+        return output, batch
+
+    def test_perfect_detection_is_one(self):
+        from analytics_zoo_tpu.pipelines import CocoMeanAveragePrecision
+
+        m = CocoMeanAveragePrecision(n_classes=2)
+        out, batch = self._batch([0.1, 0.1, 0.6, 0.6], [0.1, 0.1, 0.6, 0.6])
+        assert m(out, batch).result() == pytest.approx(1.0)
+
+    def test_partial_iou_counts_fraction_of_thresholds(self):
+        from analytics_zoo_tpu.pipelines import CocoMeanAveragePrecision
+
+        m = CocoMeanAveragePrecision(n_classes=2)
+        # gt [0,0,1,0.5] vs det [0,0,1,0.36]: IoU = .36/.5 = 0.72 ->
+        # TP at thresholds .50-.70 (5 of 10) -> mAP 0.5
+        out, batch = self._batch([0.0, 0.0, 1.0, 0.5], [0.0, 0.0, 1.0, 0.36])
+        r = m(out, batch)
+        assert r.result() == pytest.approx(0.5)
+        assert r.per_threshold()[:5] == [1.0] * 5
+        assert r.per_threshold()[5:] == [0.0] * 5
+
+    def test_monoid_merge(self):
+        from analytics_zoo_tpu.pipelines import CocoMeanAveragePrecision
+
+        m = CocoMeanAveragePrecision(n_classes=2)
+        out1, b1 = self._batch([0.1, 0.1, 0.6, 0.6], [0.1, 0.1, 0.6, 0.6])
+        out2, b2 = self._batch([0.2, 0.2, 0.7, 0.7], [0.5, 0.5, 0.9, 0.9])
+        merged = m(out1, b1) + m(out2, b2)
+        # one perfect TP + one total miss: AP ~0.5 at every threshold
+        # (precision drops to 1/2 for the missing gt's recall point)
+        assert 0.2 < merged.result() < 0.8
+        assert merged.result() < m(out1, b1).result()
+
+    def test_coco_matching_best_unmatched_gt(self):
+        """pycocotools semantics: a detection whose argmax gt is taken
+        must still match another unmatched gt above threshold (the VOC
+        argmax-only rule would mark it FP)."""
+        from analytics_zoo_tpu.pipelines import CocoMeanAveragePrecision
+
+        # two overlapping gts; both detections overlap A most, det2 also
+        # overlaps B above 0.5
+        output = np.zeros((1, 4, 6), np.float32)
+        output[0, 0] = [1, 0.9, 0.0, 0.0, 1.0, 0.50]   # det1 -> A exactly
+        output[0, 1] = [1, 0.8, 0.0, 0.0, 1.0, 0.45]   # det2: A iou .9, B iou ~.53
+        batch = {"target": {
+            "bboxes": np.asarray([[[0.0, 0.0, 1.0, 0.50],     # A
+                                   [0.0, 0.10, 1.0, 0.55]]],  # B
+                                 np.float32),
+            "labels": np.asarray([[1, 1]], np.int32),
+            "mask": np.ones((1, 2), np.float32),
+        }}
+        r = CocoMeanAveragePrecision(n_classes=2,
+                                     thresholds=[0.5])(output, batch)
+        # both dets TP at IoU .5 -> AP 1.0
+        assert r.result() == pytest.approx(1.0)
+
+    def test_grad_accum_batch_validation(self):
+        import jax.numpy as jnp
+        from flax import linen as nn
+
+        from analytics_zoo_tpu.core.criterion import MSECriterion
+        from analytics_zoo_tpu.core.module import Model
+        from analytics_zoo_tpu.parallel import (SGD, create_train_state,
+                                                make_train_step)
+
+        m = Model(nn.Dense(2))
+        m.build(0, jnp.zeros((1, 4), jnp.float32))
+        optim = SGD(0.1)
+        state = create_train_state(m, optim)
+        step = make_train_step(m.module, MSECriterion(), optim, grad_accum=3)
+        bad = {"input": np.zeros((16, 4), np.float32),
+               "target": np.zeros((16, 2), np.float32)}
+        with pytest.raises(ValueError, match="divisible"):
+            step(state, bad, 1.0)
