@@ -49,7 +49,9 @@ from analytics_zoo_tpu.parallel import (
     make_eval_step,
     multistep,
 )
-from analytics_zoo_tpu.pipelines.evaluation import DetectionResult, MeanAveragePrecision
+from analytics_zoo_tpu.pipelines.evaluation import (
+    CocoMeanAveragePrecision, DetectionResult, MeanAveragePrecision,
+    MultiIoUResult)
 from analytics_zoo_tpu.transform.vision import (
     BytesToMat,
     ColorJitter,
@@ -332,9 +334,14 @@ class SSDMeanAveragePrecision:
 
     def __init__(self, n_classes: int = 21, resolution: int = 300,
                  post: Optional[DetectionOutputParam] = None,
-                 use_07_metric: bool = True):
-        self.inner = MeanAveragePrecision(n_classes=n_classes,
-                                          use_07_metric=use_07_metric)
+                 use_07_metric: bool = True, metric: str = "voc"):
+        if metric == "coco":
+            self.inner = CocoMeanAveragePrecision(n_classes=n_classes)
+        elif metric == "voc":
+            self.inner = MeanAveragePrecision(n_classes=n_classes,
+                                              use_07_metric=use_07_metric)
+        else:
+            raise ValueError(f"metric must be 'voc' or 'coco', got {metric!r}")
         self.post = post or DetectionOutputParam(n_classes=n_classes)
         priors, variances = build_priors(
             ssd300_config() if resolution == 300 else ssd512_config())
@@ -342,7 +349,7 @@ class SSDMeanAveragePrecision:
         self._variances = jnp.asarray(variances)
         self.name = self.inner.name
 
-    def __call__(self, output, batch) -> DetectionResult:
+    def __call__(self, output, batch) -> "DetectionResult | MultiIoUResult":
         loc, conf = output
         probs = jax.nn.softmax(conf, axis=-1)
         dets = detection_output(loc, probs, self._priors, self._variances,

@@ -75,3 +75,29 @@ class StepTimer:
         logger.info("[%s] %d in %.2f seconds. Throughput is %.2f records/sec "
                     "(%.1f ms/step)", self.name, s["records"], s["total_s"],
                     s["records_per_sec"], s["mean_ms"])
+
+
+def memory_summary() -> Dict[str, Dict[str, float]]:
+    """Per-device HBM usage in MB (where the backend exposes
+    ``memory_stats`` — TPU/GPU; CPU devices report {}).  The observability
+    the reference delegated to Spark's executor UI."""
+    import jax
+
+    out: Dict[str, Dict[str, float]] = {}
+    for d in jax.local_devices():
+        stats = d.memory_stats() if hasattr(d, "memory_stats") else None
+        if not stats:
+            out[str(d)] = {}
+            continue
+        out[str(d)] = {
+            k: round(v / 1e6, 2)
+            for k, v in stats.items()
+            if isinstance(v, (int, float)) and "bytes" in k
+        }
+    return out
+
+
+def log_memory(prefix: str = "memory") -> None:
+    for dev, stats in memory_summary().items():
+        if stats:
+            logger.info("%s %s: %s", prefix, dev, stats)

@@ -406,3 +406,12 @@ class TestCocoMeanAveragePrecision:
                "target": np.zeros((16, 2), np.float32)}
         with pytest.raises(ValueError, match="divisible"):
             step(state, bad, 1.0)
+
+    def test_ssd_metric_option(self):
+        from analytics_zoo_tpu.pipelines.evaluation import MultiIoUResult
+        from analytics_zoo_tpu.pipelines.ssd import SSDMeanAveragePrecision
+
+        m = SSDMeanAveragePrecision(n_classes=4, metric="coco")
+        assert m.name == "mAP@[.5:.95]"
+        with pytest.raises(ValueError, match="voc.*coco"):
+            SSDMeanAveragePrecision(n_classes=4, metric="cocco")

@@ -141,3 +141,13 @@ class TestReportHelper:
         text = out.read_text()
         assert "## T" in text and json.loads(
             text.split("```json\n")[1].split("```")[0]) == {"a": 1}
+
+
+class TestMemorySummary:
+    def test_memory_summary_runs(self):
+        from analytics_zoo_tpu.utils.profiling import memory_summary
+
+        out = memory_summary()
+        assert isinstance(out, dict) and len(out) >= 1
+        for stats in out.values():
+            assert isinstance(stats, dict)
