@@ -210,9 +210,11 @@ class DeepSpeech2Pipeline:
 
 def make_ds2_model(hidden: int = 1024, n_rnn_layers: int = 3,
                    n_mels: int = 13, utt_length: int = 300,
-                   seed: int = 0) -> Model:
+                   seed: int = 0, bidirectional: bool = True) -> Model:
+    """``bidirectional=False`` builds the forward-only (streamable)
+    variant consumed by :class:`StreamingDS2`."""
     model = Model(DeepSpeech2(hidden=hidden, n_rnn_layers=n_rnn_layers,
-                              n_mels=n_mels))
+                              n_mels=n_mels, bidirectional=bidirectional))
     model.build(seed, jnp.zeros((1, utt_length, n_mels)))
     return model
 

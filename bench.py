@@ -303,10 +303,37 @@ def bench_ds2(args, mesh):
     assert len(out) == n_utt
     per_sec = n_utt / dt
     audio_rtf = n_utt * sec / dt
-    return _emit("ds2_utterances_per_sec", per_sec, "utterances/sec", None,
-                 utterance_seconds=sec, realtime_factor=round(audio_rtf, 1),
-                 note="segment+FFT/mel featurize+forward+CTC decode+rejoin; "
-                      "reference logs wall time only (batch-1 udf)")
+    _emit("ds2_utterances_per_sec", per_sec, "utterances/sec", None,
+          utterance_seconds=sec, realtime_factor=round(audio_rtf, 1),
+          note="segment+FFT/mel featurize+forward+CTC decode+rejoin; "
+               "reference logs wall time only (batch-1 udf)")
+
+    # streaming path: 1 s feeds through the stateful StreamingDS2 —
+    # realtime factor = audio seconds per wall second (must be >> 1 to
+    # keep up with a live source)
+    from analytics_zoo_tpu.pipelines.deepspeech2 import StreamingDS2
+
+    uni = make_ds2_model(hidden=args.ds2_hidden,
+                         n_rnn_layers=args.ds2_layers,
+                         utt_length=100, bidirectional=False)
+    stream = StreamingDS2(uni)
+    wave = rng.randn(16000 * sec).astype(np.float32) * 0.1
+    # warm ALL THREE compiled shapes: >= 2 full 100-frame blocks (first
+    # block + steady block) then flush — 33600 samples = 208 frames
+    stream.accept(wave[:16000])
+    stream.accept(wave[16000:33600])
+    stream.flush()
+    stream.reset()
+    t0 = time.perf_counter()
+    for k in range(0, len(wave), 16000):                     # 1 s feeds
+        stream.accept(wave[k:k + 16000])
+    stream.flush()
+    dt_s = time.perf_counter() - t0
+    rtf = sec / dt_s
+    return _emit("ds2_streaming_realtime_factor", rtf, "x", None,
+                 chunk_seconds=1,
+                 note="stateful StreamingDS2 (unidirectional), 1 s feeds; "
+                      "audio-seconds processed per wall-second")
 
 
 def main() -> int:
