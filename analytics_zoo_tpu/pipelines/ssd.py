@@ -90,6 +90,11 @@ class PreProcessParam:
     # Replaces the global shuffle Spark RDD repartitioning provided.
     shuffle_buffer: int = 0
     shuffle_seed: int = 0
+    # device-augmentation staging canvas (None = DeviceAugParam default
+    # 512).  Images larger than this are pre-downscaled on host; a tight
+    # canvas cuts host→device transfer bytes (the staging tensor is the
+    # whole uint8 canvas) at the cost of resolution for oversized images.
+    canvas_size: Optional[int] = None
 
 
 class RecordToFeature(Transformer):
@@ -198,9 +203,12 @@ def load_train_set_device(pattern: str, param: PreProcessParam,
                                                     DeviceAugPrepare,
                                                     make_device_augment)
 
-    aug = aug or DeviceAugParam(resolution=param.resolution,
-                                pixel_means=tuple(param.pixel_means))
-    chain = (RecordToFeature() >> BytesToMat() >> RoiNormalize()
+    if aug is None:
+        extra = ({"canvas_size": param.canvas_size}
+                 if param.canvas_size else {})
+        aug = DeviceAugParam(resolution=param.resolution,
+                             pixel_means=tuple(param.pixel_means), **extra)
+    chain = (RecordToFeature() >> BytesToMat(to_float=False) >> RoiNormalize()
              >> DeviceAugPrepare(aug))
     ds = DataSet.from_record_files(pattern, SSDByteRecord.decode,
                                    shuffle_files=True)

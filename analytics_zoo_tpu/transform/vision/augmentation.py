@@ -35,9 +35,13 @@ class BytesToMat(FeatureTransformer):
     for non-JPEG bytes or when the native lib isn't built.
     """
 
-    def __init__(self, use_native: bool = True):
+    def __init__(self, use_native: bool = True, to_float: bool = True):
+        # to_float=False keeps the decoded uint8 mat — the device-side
+        # augmentation path (``DeviceAugPrepare``) stages uint8 canvases,
+        # so the float32 round-trip would be two wasted full-image passes
         super().__init__()
         self.use_native = use_native
+        self.to_float = to_float
 
     def transform(self, feature: ImageFeature) -> ImageFeature:
         if not feature.is_valid:
@@ -52,7 +56,7 @@ class BytesToMat(FeatureTransformer):
                 mat = cv2.imdecode(buf, cv2.IMREAD_COLOR)
             if mat is None:
                 raise ValueError("imdecode failed")
-            feature.mat = mat.astype(np.float32)
+            feature.mat = mat.astype(np.float32) if self.to_float else mat
             feature["original_width"] = mat.shape[1]
             feature["original_height"] = mat.shape[0]
         except Exception:

@@ -60,18 +60,27 @@ class RoiLabel:
 # ---------------------------------------------------------------------------
 
 
+def jaccard_overlap_matrix(a_boxes: np.ndarray,
+                           b_boxes: np.ndarray) -> np.ndarray:
+    """Pairwise IoU of (T,4) against (G,4) normalized boxes → (T,G)
+    (vectorized ``util/BboxUtil.jaccardOverlap``)."""
+    x1 = np.maximum(a_boxes[:, None, 0], b_boxes[None, :, 0])
+    y1 = np.maximum(a_boxes[:, None, 1], b_boxes[None, :, 1])
+    x2 = np.minimum(a_boxes[:, None, 2], b_boxes[None, :, 2])
+    y2 = np.minimum(a_boxes[:, None, 3], b_boxes[None, :, 3])
+    inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    a = ((a_boxes[:, 2] - a_boxes[:, 0])
+         * (a_boxes[:, 3] - a_boxes[:, 1]))[:, None]
+    b = ((b_boxes[:, 2] - b_boxes[:, 0])
+         * (b_boxes[:, 3] - b_boxes[:, 1]))[None, :]
+    union = a + b - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+
 def jaccard_overlap(box: np.ndarray, boxes: np.ndarray) -> np.ndarray:
     """IoU of one normalized box against (N,4) boxes (reference
     ``util/BboxUtil.jaccardOverlap``)."""
-    x1 = np.maximum(box[0], boxes[:, 0])
-    y1 = np.maximum(box[1], boxes[:, 1])
-    x2 = np.minimum(box[2], boxes[:, 2])
-    y2 = np.minimum(box[3], boxes[:, 3])
-    inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
-    a = (box[2] - box[0]) * (box[3] - box[1])
-    b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
-    union = a + b - inter
-    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+    return jaccard_overlap_matrix(box[None, :], boxes)[0]
 
 
 def meet_emit_center_constraint(src_box: np.ndarray,

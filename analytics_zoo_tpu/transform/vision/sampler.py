@@ -11,7 +11,6 @@ IoU ≤ 1.0), picks one sampled box at random, and applies Crop + RoiCrop.
 from __future__ import annotations
 
 import dataclasses
-import math
 import random
 from typing import List, Optional
 
@@ -19,7 +18,12 @@ import numpy as np
 
 from analytics_zoo_tpu.transform.vision.augmentation import Crop
 from analytics_zoo_tpu.transform.vision.image import FeatureTransformer, ImageFeature
-from analytics_zoo_tpu.transform.vision.roi import RoiCrop, RoiLabel, jaccard_overlap
+from analytics_zoo_tpu.transform.vision.roi import (
+    RoiCrop,
+    RoiLabel,
+    jaccard_overlap,
+    jaccard_overlap_matrix,
+)
 
 
 @dataclasses.dataclass
@@ -36,15 +40,25 @@ class BatchSampler:
     max_overlap: Optional[float] = None
 
     def sample_box(self) -> np.ndarray:
-        scale = random.uniform(self.min_scale, self.max_scale)
-        min_ar = max(self.min_aspect_ratio, scale ** 2)
-        max_ar = min(self.max_aspect_ratio, 1.0 / (scale ** 2))
-        ar = random.uniform(min_ar, max_ar)
-        w = scale * math.sqrt(ar)
-        h = scale / math.sqrt(ar)
-        x1 = random.uniform(0.0, 1.0 - w)
-        y1 = random.uniform(0.0, 1.0 - h)
-        return np.array([x1, y1, x1 + w, y1 + h], np.float32)
+        return self.sample_boxes(1)[0]
+
+    def sample_boxes(self, n: int) -> np.ndarray:
+        """(n, 4) candidate crops drawn at once — the vectorized form of the
+        reference's per-trial draw (``BatchSampler.sample:54``); one numpy
+        pass replaces ``n`` scalar RNG round-trips (HOT LOOP #1 host cost).
+
+        Seeded from the ``random`` module so ``random.seed(s)`` still pins
+        the whole augmentation chain (crops included) to one seed."""
+        rng = np.random.default_rng(random.getrandbits(64))
+        scale = rng.uniform(self.min_scale, self.max_scale, n)
+        min_ar = np.maximum(self.min_aspect_ratio, scale ** 2)
+        max_ar = np.minimum(self.max_aspect_ratio, 1.0 / (scale ** 2))
+        ar = rng.uniform(min_ar, max_ar)
+        w = scale * np.sqrt(ar)
+        h = scale / np.sqrt(ar)
+        x1 = rng.uniform(0.0, 1.0, n) * (1.0 - w)
+        y1 = rng.uniform(0.0, 1.0, n) * (1.0 - h)
+        return np.stack([x1, y1, x1 + w, y1 + h], axis=1).astype(np.float32)
 
     def satisfies(self, box: np.ndarray, label: RoiLabel) -> bool:
         if self.min_overlap is None and self.max_overlap is None:
@@ -61,15 +75,29 @@ class BatchSampler:
 
     def sample(self, label: RoiLabel) -> List[np.ndarray]:
         """Up to ``max_sample`` satisfying boxes in ``max_trials`` tries
-        (reference ``BatchSampler.sample:54``)."""
-        out: List[np.ndarray] = []
-        for _ in range(self.max_trials):
-            if len(out) >= self.max_sample:
-                break
-            box = self.sample_box()
-            if self.satisfies(box, label):
-                out.append(box)
-        return out
+        (reference ``BatchSampler.sample:54``).  All trials are drawn and
+        checked in one vectorized pass — in trial order, so the kept boxes
+        are distributed exactly like the reference's sequential
+        first-``max_sample`` early-exit loop."""
+        unconstrained = self.min_overlap is None and self.max_overlap is None
+        n = (min(self.max_sample, self.max_trials) if unconstrained
+             else self.max_trials)
+        if n <= 0:
+            return []
+        boxes = self.sample_boxes(n)
+        if unconstrained:
+            return list(boxes[:self.max_sample])
+        if label.size() == 0:
+            return []
+        # best-gt IoU per trial: (T, G) matrix, one numpy pass
+        best = jaccard_overlap_matrix(boxes, label.bboxes).max(axis=1)
+        ok = np.ones(n, bool)
+        if self.min_overlap is not None:
+            ok &= best >= self.min_overlap
+        if self.max_overlap is not None:
+            ok &= best <= self.max_overlap
+        keep = np.flatnonzero(ok)[:self.max_sample]
+        return [boxes[i] for i in keep]
 
 
 def standard_samplers() -> List[BatchSampler]:

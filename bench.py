@@ -105,8 +105,11 @@ def bench_ssd_train(args, mesh, shard_pattern, device_aug: bool):
     step = make_train_step(model.module, criterion, optim, mesh=mesh,
                            compute_dtype=args.compute_dtype)
 
+    # bench records are exactly res×res, so a tight staging canvas is
+    # lossless and cuts host→device bytes ~2.8× vs the 512 default
     param = PreProcessParam(batch_size=args.batch, resolution=res,
-                            num_workers=args.workers, max_gt=8)
+                            num_workers=args.workers, max_gt=8,
+                            canvas_size=((res + 7) // 8) * 8)
     if device_aug:
         dataset, augment = load_train_set_device(shard_pattern, param)
     else:
