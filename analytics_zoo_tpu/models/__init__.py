@@ -16,6 +16,13 @@ from analytics_zoo_tpu.models.ssd_variants import (
     mobilenet_ssd_config,
     multibox_heads,
 )
+from analytics_zoo_tpu.models.faster_rcnn import (
+    FasterRcnnDetector,
+    FasterRcnnVgg,
+    FrcnnParam,
+    decode_frcnn_boxes,
+    frcnn_vgg_rename,
+)
 from analytics_zoo_tpu.models.deepspeech2 import (
     DeepSpeech2,
     SequenceBN,

@@ -1,0 +1,200 @@
+"""Faster-RCNN VGG16 detector, TPU-first.
+
+The reference supports Faster-RCNN by building graphs out of its custom
+ops through the Caffe importer (``common/caffe/CaffeLoader.scala``
+``FrcnnCaffeLoader:599`` registering ``PythonConverter.scala:28`` for the
+proposal layer and ``RoiPoolingConverter.scala:28``; post-processing
+``common/nn/FrcnnPostprocessor.scala:40``; anchors ``common/nn/
+Anchor.scala:25``; RPN proposal ``common/nn/Proposal.scala:33``).  This
+module is the native assembly of the same network — one flax module, so
+the whole serving path (trunk → RPN → proposal → ROI pool → heads →
+per-class NMS) is a single XLA program with static shapes:
+
+- NHWC convs on the MXU; the VGG trunk is shared with SSD conventions
+  (Caffe layer names, so ``utils.caffe`` weight import works by rename).
+- The proposal layer's dynamic "filter + sort + NMS" becomes the
+  static-shape masked formulation in ``ops.proposal`` (padded ROIs +
+  validity mask), so batching is a plain ``vmap``.
+- ROI max-pool is the masked-reduction kernel in ``ops.roi_pool`` —
+  no per-bin scalar loops.
+- Per-class box regression + NMS run in-graph (``ops.frcnn``), mirroring
+  the reference's in-model DetectionOutput philosophy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.ops.anchor import generate_base_anchors, shift_anchors
+from analytics_zoo_tpu.ops.bbox import bbox_transform_inv, clip_boxes
+from analytics_zoo_tpu.ops.frcnn import FrcnnPostParam, frcnn_postprocess
+from analytics_zoo_tpu.ops.proposal import ProposalParam, proposal
+from analytics_zoo_tpu.ops.roi_pool import roi_pool
+
+
+def _conv(x, features, name, kernel=3, stride=1, pad=1):
+    return nn.Conv(features, (kernel, kernel), strides=(stride, stride),
+                   padding=((pad, pad), (pad, pad)), name=name)(x)
+
+
+class FrcnnVggTrunk(nn.Module):
+    """VGG16 conv1_1 … conv5_3 at stride 16 (py-faster-rcnn layout — the
+    trunk of the caffemodels the reference's ``FrcnnCaffeLoader`` reads;
+    Caffe layer names kept for weight import)."""
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(_conv(x, 64, "conv1_1"))
+        x = nn.relu(_conv(x, 64, "conv1_2"))
+        x = nn.max_pool(x, (2, 2), (2, 2))
+        x = nn.relu(_conv(x, 128, "conv2_1"))
+        x = nn.relu(_conv(x, 128, "conv2_2"))
+        x = nn.max_pool(x, (2, 2), (2, 2))
+        x = nn.relu(_conv(x, 256, "conv3_1"))
+        x = nn.relu(_conv(x, 256, "conv3_2"))
+        x = nn.relu(_conv(x, 256, "conv3_3"))
+        x = nn.max_pool(x, (2, 2), (2, 2))
+        x = nn.relu(_conv(x, 512, "conv4_1"))
+        x = nn.relu(_conv(x, 512, "conv4_2"))
+        x = nn.relu(_conv(x, 512, "conv4_3"))
+        x = nn.max_pool(x, (2, 2), (2, 2))
+        x = nn.relu(_conv(x, 512, "conv5_1"))
+        x = nn.relu(_conv(x, 512, "conv5_2"))
+        x = nn.relu(_conv(x, 512, "conv5_3"))
+        return x
+
+
+@dataclasses.dataclass(frozen=True)
+class FrcnnParam:
+    """Assembly knobs (reference ``FrcnnCaffeLoader`` picks the VGG flavor
+    by its 9-anchor RPN; py-faster-rcnn test-time proposal settings)."""
+
+    num_classes: int = 21
+    anchor_ratios: Sequence[float] = (0.5, 1.0, 2.0)
+    anchor_scales: Sequence[float] = (8, 16, 32)
+    feat_stride: int = 16
+    pooled: int = 7
+    proposal: ProposalParam = ProposalParam(pre_nms_topn=6000,
+                                            post_nms_topn=300)
+
+    @property
+    def num_anchors(self) -> int:
+        return len(self.anchor_ratios) * len(self.anchor_scales)
+
+
+class FasterRcnnVgg(nn.Module):
+    """Trunk + RPN + proposal + ROI pool + classification heads.
+
+    ``__call__(x, im_info)`` with ``x`` (B, H, W, 3) BGR mean-subtracted
+    pixels and ``im_info`` (B, 3) rows ``(height, width, scale)`` returns
+    ``(rois, roi_mask, cls_probs, bbox_deltas)``:
+
+    - rois (B, R, 4) pixel boxes, roi_mask (B, R) validity
+    - cls_probs (B, R, C) softmax class probabilities
+    - bbox_deltas (B, R, C·4) per-class regression deltas
+    """
+
+    param: FrcnnParam = FrcnnParam()
+
+    @nn.compact
+    def __call__(self, x, im_info, train: bool = False):
+        p = self.param
+        feat = FrcnnVggTrunk(name="vgg")(x)                # (B, h, w, 512)
+        B, h, w, _ = feat.shape
+
+        rpn = nn.relu(_conv(feat, 512, "rpn_conv_3x3"))
+        # Caffe channel layout: cls channels = [bg × A, fg × A] (softmax
+        # over a reshaped leading 2), bbox channels = anchor-major ×4
+        rpn_cls = _conv(rpn, 2 * p.num_anchors, "rpn_cls_score",
+                        kernel=1, pad=0)
+        rpn_bbox = _conv(rpn, 4 * p.num_anchors, "rpn_bbox_pred",
+                         kernel=1, pad=0)
+        cls_pair = rpn_cls.reshape(B, h, w, 2, p.num_anchors)
+        fg = jax.nn.softmax(cls_pair, axis=3)[:, :, :, 1, :]   # (B,h,w,A)
+        scores = fg.reshape(B, -1)                             # h·w·A order
+        deltas = rpn_bbox.reshape(B, h, w, p.num_anchors, 4).reshape(
+            B, -1, 4)
+
+        anchors = jnp.asarray(shift_anchors(
+            generate_base_anchors(ratios=p.anchor_ratios,
+                                  scales=p.anchor_scales),
+            h, w, p.feat_stride))                              # (h·w·A, 4)
+
+        def one(s, d, info):
+            return proposal(s, d, anchors, info[0], info[1], info[2],
+                            param=p.proposal)
+
+        rois, roi_mask = jax.vmap(one)(scores, deltas, im_info)
+
+        pooled = jax.vmap(
+            lambda f, r, m: roi_pool(f, r, m, pooled_h=p.pooled,
+                                     pooled_w=p.pooled,
+                                     spatial_scale=1.0 / p.feat_stride)
+        )(feat, rois, roi_mask)                       # (B, R, 7, 7, 512)
+        flat = pooled.reshape(B, pooled.shape[1], -1)
+
+        y = nn.relu(nn.Dense(4096, name="fc6")(flat))
+        y = nn.Dropout(0.5, deterministic=not train)(y)
+        y = nn.relu(nn.Dense(4096, name="fc7")(y))
+        y = nn.Dropout(0.5, deterministic=not train)(y)
+        cls_probs = jax.nn.softmax(
+            nn.Dense(p.num_classes, name="cls_score")(y), axis=-1)
+        bbox_deltas = nn.Dense(p.num_classes * 4, name="bbox_pred")(y)
+        return rois, roi_mask, cls_probs, bbox_deltas
+
+
+def decode_frcnn_boxes(rois: jax.Array, bbox_deltas: jax.Array,
+                       im_info: jax.Array) -> jax.Array:
+    """Per-class box regression (reference ``BboxUtil.bboxTransformInv:520``
+    applied class-wise) + clip to image → (R, C·4) pixel boxes, the layout
+    ``ops.frcnn.frcnn_postprocess`` consumes."""
+    R = rois.shape[0]
+    C = bbox_deltas.shape[-1] // 4
+    deltas = bbox_deltas.reshape(R, C, 4)
+    boxes = jax.vmap(lambda d: bbox_transform_inv(rois, d),
+                     in_axes=1, out_axes=1)(deltas)          # (R, C, 4)
+    boxes = clip_boxes(boxes, im_info[0] - 1.0, im_info[1] - 1.0)
+    return boxes.reshape(R, C * 4)
+
+
+class FasterRcnnDetector(nn.Module):
+    """Faster-RCNN with in-graph post-processing: one jitted forward from
+    pixels to padded ``(B, max_per_image, 6)`` detections ``(class, score,
+    x1, y1, x2, y2)`` — the serving assembly the reference reaches via
+    ``FrcnnCaffeLoader`` + ``FrcnnPostprocessor`` (``Predict.scala``)."""
+
+    param: FrcnnParam = FrcnnParam()
+    post: FrcnnPostParam = FrcnnPostParam()
+
+    @nn.compact
+    def __call__(self, x, im_info):
+        post = dataclasses.replace(self.post,
+                                   n_classes=self.param.num_classes)
+        rois, roi_mask, cls_probs, bbox_deltas = FasterRcnnVgg(
+            param=self.param, name="frcnn")(x, im_info)
+        cls_probs = cls_probs * roi_mask[..., None]   # padded ROIs score 0
+
+        def one(r, s, d, info):
+            return frcnn_postprocess(s, decode_frcnn_boxes(r, d, info),
+                                     param=post)
+
+        return jax.vmap(one)(rois, cls_probs, bbox_deltas, im_info)
+
+
+def frcnn_vgg_rename():
+    """Caffe py-faster-rcnn layer names → this module's param tree names
+    (``rpn_conv/3x3`` can't be a flax scope name; everything else maps
+    1:1).  Use with ``utils.caffe.load_caffe_weights``."""
+    mapping = {"rpn_conv/3x3/weight": "rpn_conv_3x3/weight",
+               "rpn_conv/3x3/bias": "rpn_conv_3x3/bias"}
+
+    def rename(key: str) -> str:
+        return mapping.get(key, key)
+
+    return rename

@@ -53,7 +53,11 @@ class BatchSampler:
         scale = rng.uniform(self.min_scale, self.max_scale, n)
         min_ar = np.maximum(self.min_aspect_ratio, scale ** 2)
         max_ar = np.minimum(self.max_aspect_ratio, 1.0 / (scale ** 2))
-        ar = rng.uniform(min_ar, max_ar)
+        # a + u·(b-a) rather than rng.uniform(a, b): numpy's Generator
+        # raises on inverted bounds, but custom sampler configs can invert
+        # (min_aspect_ratio > 1 with large scale) — random.uniform accepted
+        # that, and one bad element must not poison the whole draw
+        ar = min_ar + rng.uniform(0.0, 1.0, n) * (max_ar - min_ar)
         w = scale * np.sqrt(ar)
         h = scale / np.sqrt(ar)
         x1 = rng.uniform(0.0, 1.0, n) * (1.0 - w)
@@ -82,13 +86,11 @@ class BatchSampler:
         unconstrained = self.min_overlap is None and self.max_overlap is None
         n = (min(self.max_sample, self.max_trials) if unconstrained
              else self.max_trials)
-        if n <= 0:
+        if n <= 0 or (not unconstrained and label.size() == 0):
             return []
         boxes = self.sample_boxes(n)
         if unconstrained:
             return list(boxes[:self.max_sample])
-        if label.size() == 0:
-            return []
         # best-gt IoU per trial: (T, G) matrix, one numpy pass
         best = jaccard_overlap_matrix(boxes, label.bboxes).max(axis=1)
         ok = np.ones(n, bool)

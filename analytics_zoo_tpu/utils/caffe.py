@@ -405,6 +405,49 @@ def load_ssd_vgg_caffe(params: Any, caffemodel_path: str,
                               rename=ssd_vgg_rename(resolution), strict=strict)
 
 
+def chw_dense_to_hwc(weight: np.ndarray, h: int, w: int, c: int) -> np.ndarray:
+    """Permute a Caffe InnerProduct weight's input axis from CHW flatten
+    order to this framework's HWC flatten order.
+
+    Caffe flattens a (C, H, W) blob as ``c·H·W + y·W + x``; NHWC models
+    flatten ``(H, W, C)`` as ``y·W·C + x·C + c``.  A Dense kernel imported
+    by name alone would pair every input element with the wrong row
+    (reference converts layouts per layer the same way,
+    ``LayerConverter.scala:39`` weight fixups).  ``weight`` is (out, in) or
+    (in, out); the permuted array keeps the same shape.
+    """
+    if weight.shape[0] == h * w * c:            # (in, out) — flax layout
+        return (weight.reshape(c, h, w, -1).transpose(1, 2, 0, 3)
+                .reshape(h * w * c, -1))
+    if weight.shape[-1] == h * w * c:           # (out, in) — caffe layout
+        return (weight.reshape(-1, c, h, w).transpose(0, 2, 3, 1)
+                .reshape(weight.shape[0], h * w * c))
+    raise ValueError(f"no axis of {weight.shape} matches {h}x{w}x{c}")
+
+
+def load_frcnn_vgg_caffe(params: Any, caffemodel_path: str,
+                         pooled: int = 7, pool_channels: int = 512,
+                         strict: bool = False) -> Tuple[Any, Dict[str, list]]:
+    """py-faster-rcnn VGG16 caffemodel → ``models.faster_rcnn`` params.
+
+    By-name copy (``CaffeLoader.load`` equivalent) plus the one layout
+    fixup name matching can't express: fc6 consumes the ROI-pooled
+    (7, 7, 512) map, flattened CHW by Caffe but HWC here, so its kernel's
+    input axis is permuted with :func:`chw_dense_to_hwc`.
+    """
+    from analytics_zoo_tpu.models.faster_rcnn import frcnn_vgg_rename
+
+    net = read_caffemodel(caffemodel_path)
+    src = caffe_weight_dict(net)
+    key = "fc6/weight"
+    if key in src:
+        src[key] = chw_dense_to_hwc(src[key], pooled, pooled, pool_channels)
+    from analytics_zoo_tpu.utils.convert import load_weights_by_name
+
+    return load_weights_by_name(params, src, rename=frcnn_vgg_rename(),
+                                strict=strict)
+
+
 # ---------------------------------------------------------------------------
 # graph building ("loadCaffe" mode)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,154 @@
+"""Native Faster-RCNN assembly (models/faster_rcnn.py): the end-to-end
+composition of ops the reference reaches through its Caffe importer
+(``FrcnnCaffeLoader``, ``Proposal.scala``, ``ROIPooling``,
+``FrcnnPostprocessor.scala``)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.models import (FasterRcnnDetector, FasterRcnnVgg,
+                                      FrcnnParam, decode_frcnn_boxes,
+                                      frcnn_vgg_rename)
+from analytics_zoo_tpu.ops.proposal import ProposalParam
+
+# small end-to-end shapes: 128px image -> 8x8 conv5 map
+PARAM = FrcnnParam(num_classes=4,
+                   proposal=ProposalParam(pre_nms_topn=64, post_nms_topn=16))
+
+
+def _im_info(b, size):
+    return jnp.tile(jnp.asarray([[size, size, 1.0]], jnp.float32), (b, 1))
+
+
+def test_forward_shapes_and_mask():
+    model = FasterRcnnVgg(param=PARAM)
+    x = jnp.zeros((2, 128, 128, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, _im_info(2, 128))
+    rois, mask, probs, deltas = model.apply(variables, x, _im_info(2, 128))
+    R = PARAM.proposal.post_nms_topn
+    assert rois.shape == (2, R, 4)
+    assert mask.shape == (2, R)
+    assert probs.shape == (2, R, 4)
+    assert deltas.shape == (2, R, 16)
+    # softmax head: rows sum to one
+    np.testing.assert_allclose(np.asarray(probs.sum(-1)), 1.0, atol=1e-5)
+    # at least one proposal survives NMS even on a flat image
+    assert float(mask.sum()) >= 2
+
+
+def test_detector_in_graph_postprocess():
+    det = FasterRcnnDetector(param=PARAM)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 128, 128, 3)) * 10
+    variables = det.init(jax.random.PRNGKey(0), x, _im_info(1, 128))
+    fwd = jax.jit(lambda v, a, i: det.apply(v, a, i))
+    out = fwd(variables, x, _im_info(1, 128))
+    assert out.shape == (1, det.post.max_per_image, 6)
+    out = np.asarray(out)
+    kept = out[0][out[0, :, 1] > 0]
+    # kept rows: class in [1, C), boxes inside the image
+    if kept.size:
+        assert ((kept[:, 0] >= 1) & (kept[:, 0] < 4)).all()
+        assert (kept[:, 2:] >= 0).all() and (kept[:, 2:] <= 127).all()
+    # padded rows are class -1 / zero score
+    pad = out[0][out[0, :, 1] <= 0]
+    assert (pad[:, 0] == -1).all()
+
+
+def test_decode_frcnn_boxes_zero_deltas_identity():
+    rois = jnp.asarray([[10.0, 20.0, 50.0, 60.0],
+                        [0.0, 0.0, 30.0, 30.0]])
+    deltas = jnp.zeros((2, 12))                       # 3 classes
+    out = decode_frcnn_boxes(rois, deltas, jnp.asarray([128.0, 128.0, 1.0]))
+    np.testing.assert_allclose(np.asarray(out).reshape(2, 3, 4)[:, 1],
+                               np.asarray(rois), atol=1e-5)
+
+
+def test_param_tree_uses_caffe_names():
+    model = FasterRcnnVgg(param=PARAM)
+    x = jnp.zeros((1, 160, 96, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, _im_info(1, 160))
+    p = variables["params"]
+    for name in ("conv1_1", "conv5_3"):
+        assert name in p["vgg"]
+    for name in ("rpn_conv_3x3", "rpn_cls_score", "rpn_bbox_pred",
+                 "fc6", "fc7", "cls_score", "bbox_pred"):
+        assert name in p
+
+
+def test_rename_helper():
+    rn = frcnn_vgg_rename()
+    assert rn("rpn_conv/3x3/weight") == "rpn_conv_3x3/weight"
+    assert rn("conv1_1/weight") == "conv1_1/weight"
+
+
+def test_caffe_weight_import_roundtrip():
+    """Weights written as a py-faster-rcnn-shaped caffemodel load into the
+    native model by name (the reference's ``CaffeLoader.load`` path)."""
+    from analytics_zoo_tpu.utils.caffe import (CaffeLayer, CaffeNet,
+                                               caffe_weight_dict)
+    from analytics_zoo_tpu.utils.convert import load_weights_by_name
+
+    model = FasterRcnnVgg(param=PARAM)
+    x = jnp.zeros((1, 128, 128, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, _im_info(1, 128))
+    params = variables["params"]
+
+    # build a fake caffemodel holding a recognisable rpn_conv/3x3 kernel
+    k = np.asarray(params["rpn_conv_3x3"]["kernel"])     # (3,3,512,512) HWIO
+    caffe_k = np.full(k.transpose(3, 2, 0, 1).shape, 0.5, np.float32)
+    net = CaffeNet(layers=[CaffeLayer(
+        name="rpn_conv/3x3", type="Convolution",
+        blobs=[caffe_k, np.zeros(k.shape[-1], np.float32)])])
+    new, report = load_weights_by_name(
+        params, caffe_weight_dict(net), rename=frcnn_vgg_rename())
+    assert "rpn_conv_3x3/kernel" in report["loaded"]
+    np.testing.assert_allclose(
+        np.asarray(new["rpn_conv_3x3"]["kernel"]), 0.5)
+
+
+def test_fc6_chw_layout_fixup(tmp_path):
+    """fc6's Caffe weight rows are ordered over a CHW flatten; the import
+    path must permute them to this framework's HWC flatten so
+    fc6(pooled_hwc) equals the Caffe computation fc6_caffe(pooled_chw)."""
+    from analytics_zoo_tpu.utils.caffe import (CaffeLayer, CaffeNet,
+                                               chw_dense_to_hwc,
+                                               load_frcnn_vgg_caffe,
+                                               save_caffemodel)
+
+    h = w = 7
+    c = 512
+    out = 32
+    rng = np.random.RandomState(0)
+    caffe_w = rng.randn(out, c * h * w).astype(np.float32)   # (out, CHW)
+    pooled_hwc = rng.randn(h, w, c).astype(np.float32)
+
+    # oracle: caffe applies its rows to the CHW flatten
+    ref = caffe_w @ pooled_hwc.transpose(2, 0, 1).ravel()
+
+    got_w = chw_dense_to_hwc(caffe_w, h, w, c)
+    np.testing.assert_allclose(got_w @ pooled_hwc.ravel(), ref,
+                               rtol=1e-3, atol=1e-3)
+
+    # and through the real loader: caffemodel bytes -> params
+    model = FasterRcnnVgg(param=PARAM)
+    x = jnp.zeros((1, 128, 128, 3), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x, _im_info(1, 128))["params"]
+    fc6_in = params["fc6"]["kernel"].shape[0]               # 7*7*512
+    assert fc6_in == c * h * w
+    full_w = rng.randn(4096, fc6_in).astype(np.float32)
+    path = str(tmp_path / "frcnn.caffemodel")
+    save_caffemodel(path, CaffeNet(layers=[CaffeLayer(
+        name="fc6", type="InnerProduct",
+        blobs=[full_w, np.zeros(4096, np.float32)])]))
+    new, report = load_frcnn_vgg_caffe(params, path)
+    assert "fc6/kernel" in report["loaded"]
+    flat = rng.randn(fc6_in).astype(np.float32)             # an HWC flatten
+    ref_full = full_w @ flat.reshape(h, w, c).transpose(2, 0, 1).ravel()
+    # summation order differs between the two matmuls — fp32 noise only
+    np.testing.assert_allclose(
+        flat @ np.asarray(new["fc6"]["kernel"]), ref_full,
+        rtol=1e-3, atol=0.05)
