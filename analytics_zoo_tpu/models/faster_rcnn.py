@@ -35,7 +35,7 @@ from analytics_zoo_tpu.ops.anchor import generate_base_anchors, shift_anchors
 from analytics_zoo_tpu.ops.bbox import bbox_transform_inv, clip_boxes
 from analytics_zoo_tpu.ops.frcnn import FrcnnPostParam, frcnn_postprocess
 from analytics_zoo_tpu.ops.proposal import ProposalParam, proposal
-from analytics_zoo_tpu.ops.roi_pool import roi_pool
+from analytics_zoo_tpu.ops.roi_pool import roi_pool_batch
 
 
 def _conv(x, features, name, kernel=3, stride=1, pad=1):
@@ -132,11 +132,10 @@ class FasterRcnnVgg(nn.Module):
 
         rois, roi_mask = jax.vmap(one)(scores, deltas, im_info)
 
-        pooled = jax.vmap(
-            lambda f, r, m: roi_pool(f, r, m, pooled_h=p.pooled,
-                                     pooled_w=p.pooled,
-                                     spatial_scale=1.0 / p.feat_stride)
-        )(feat, rois, roi_mask)                       # (B, R, 7, 7, 512)
+        pooled = roi_pool_batch(feat, rois, roi_mask, pooled_h=p.pooled,
+                                pooled_w=p.pooled,
+                                spatial_scale=1.0 / p.feat_stride)
+        # (B, R, 7, 7, 512)
         flat = pooled.reshape(B, pooled.shape[1], -1)
 
         y = nn.relu(nn.Dense(4096, name="fc6")(flat))

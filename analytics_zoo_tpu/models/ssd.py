@@ -242,8 +242,11 @@ class SSDDetector(nn.Module):
         self.ssd = SSDVgg(num_classes=self.num_classes,
                           resolution=self.resolution, dataset=self.dataset)
         priors, variances = build_priors(self.ssd.config)
-        self._priors = jnp.asarray(priors)
-        self._variances = jnp.asarray(variances)
+        # host numpy on purpose: when setup runs eagerly, jnp.asarray would
+        # commit device arrays that later jitted applies capture as
+        # constants — which degrades the remote-TPU (axon) transfer path
+        self._priors = np.asarray(priors)
+        self._variances = np.asarray(variances)
 
     def __call__(self, x):
         loc, conf = self.ssd(x)

@@ -24,6 +24,7 @@ import dataclasses
 from typing import Dict
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 
 from analytics_zoo_tpu.core.criterion import Criterion, smooth_l1
@@ -135,8 +136,11 @@ class MultiBoxLoss(Criterion):
 
     def __init__(self, priors, variances,
                  param: MultiBoxLossParam = MultiBoxLossParam()):
-        self.priors = jnp.asarray(priors)
-        self.variances = jnp.asarray(variances)
+        # host numpy on purpose: a jitted step that closes over a
+        # COMMITTED device array degrades the remote-TPU (axon) transfer
+        # path for the whole process; numpy constants embed safely
+        self.priors = np.asarray(priors)
+        self.variances = np.asarray(variances)
         self.param = param
 
     def __call__(self, output, target, mask=None):

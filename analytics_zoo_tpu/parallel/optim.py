@@ -20,6 +20,7 @@ import dataclasses
 from typing import Any, Callable, Dict, Optional
 
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 
@@ -31,7 +32,10 @@ import optax
 def multistep(base_lr: float, milestones, gamma: float = 0.1) -> Callable:
     """MultiStep LR: multiply by ``gamma`` at each milestone iteration
     (reference SGD ``MultiStep`` branch, ``Train.scala:206-210``)."""
-    ms = jnp.asarray(sorted(milestones))
+    # host numpy: this closure runs inside the jitted train step, and a
+    # closed-over COMMITTED device array degrades the remote-TPU
+    # transfer path process-wide
+    ms = np.asarray(sorted(milestones))
 
     def schedule(step):
         n = jnp.sum(step >= ms)

@@ -46,9 +46,12 @@ class _Summary:
         state = TrainingState(iteration=iteration, epoch_finished=True)
         return t(state)
 
-    def add_scalar(self, tag: str, value: float, iteration: int) -> None:
+    def add_scalar(self, tag: str, value, iteration: int) -> None:
+        """``value`` may be a device array: it is only forced to a host
+        float AFTER the trigger gate, so gated-off iterations never pay a
+        device→host sync (expensive when the accelerator is remote)."""
         if self.writer is not None and self._gated(tag, iteration):
-            self.writer.add_scalar(tag, value, iteration)
+            self.writer.add_scalar(tag, float(value), iteration)
 
     def add_histogram(self, tag: str, values, iteration: int) -> None:
         if self.writer is not None and self._gated(tag, iteration):

@@ -526,11 +526,12 @@ class Optimizer:
                     # sync when something host-side actually reads it
                     loop.loss = metrics["loss"]
                     if self.train_summary is not None:
+                        # device arrays on purpose: add_scalar floats them
+                        # only when the tag's trigger fires
                         self.train_summary.add_scalar(
-                            "Loss", float(metrics["loss"]), loop.iteration)
+                            "Loss", metrics["loss"], loop.iteration)
                         self.train_summary.add_scalar(
-                            "LearningRate", float(metrics["lr"]),
-                            loop.iteration)
+                            "LearningRate", metrics["lr"], loop.iteration)
                     self._maybe_validate(loop, state, eval_step)
                     self._maybe_checkpoint(loop, state)
                     if self.end_when(loop):

@@ -43,6 +43,7 @@ from analytics_zoo_tpu.pipelines.ssd import (
     train_transformer,
     val_transformer,
 )
+from analytics_zoo_tpu.pipelines.frcnn import FRCNN_BGR_MEANS, FrcnnPredictor
 from analytics_zoo_tpu.pipelines.fraud import (
     FraudResult,
     MLPClassifier,

@@ -1,0 +1,87 @@
+"""Faster-RCNN serving pipeline (reference ``ssd/example/Predict.scala``
+with ``FrcnnCaffeLoader`` + ``common/Predictor.scala``): preprocess chain →
+one jitted detector forward (trunk → RPN → proposal → ROI pool → heads →
+per-class NMS in-graph) → detections rescaled to original image size.
+
+TPU-first deviation from the reference: the reference's Faster-RCNN
+preprocess is aspect-preserving ``AspectScale(600, max 1000)`` which
+yields variable input shapes (fine on CPU, a recompile per shape under
+XLA).  Serving here resizes to one fixed square resolution so every batch
+reuses a single compiled program; ``im_info`` scale factors restore
+original-size pixel boxes, exactly like the SSD path
+(``BboxUtil.scaleBatchOutput:384``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from analytics_zoo_tpu.models.faster_rcnn import FasterRcnnDetector, FrcnnParam
+from analytics_zoo_tpu.ops.frcnn import FrcnnPostParam
+from analytics_zoo_tpu.pipelines.ssd import (
+    PreProcessParam,
+    run_serving_loop,
+    serving_chain,
+)
+
+# py-faster-rcnn BGR channel means (its models were trained with these,
+# not the SSD-Caffe 104/117/123)
+FRCNN_BGR_MEANS = (102.9801, 115.9465, 122.7717)
+
+
+class FrcnnPredictor:
+    """``SSDPredictor`` counterpart for the Faster-RCNN family.
+
+    ``detector`` is a built ``FasterRcnnDetector`` module; ``variables``
+    its params (e.g. from ``utils.caffe.load_frcnn_vgg_caffe``).
+    """
+
+    def __init__(self, detector: FasterRcnnDetector, variables,
+                 param: Optional[PreProcessParam] = None):
+        self.detector = detector
+        self.variables = variables
+        self.param = param or PreProcessParam(
+            resolution=512, pixel_means=FRCNN_BGR_MEANS)
+        self._fwd = jax.jit(
+            lambda v, x, info: detector.apply(v, x, info))
+
+    def _detect_device(self, batch: Dict):
+        """Dispatch one batch (async); returns (device detections,
+        scale_h, scale_w) — boxes still in resized-image pixels."""
+        b = batch["input"].shape[0]
+        res = float(self.param.resolution)
+        # detector im_info rows are (height, width, scale); min_size
+        # filtering in the proposal layer uses the scale factor
+        scale_h = np.maximum(batch["im_info"][:, 2], 1e-8)
+        scale_w = np.maximum(batch["im_info"][:, 3], 1e-8)
+        info = np.stack([np.full(b, res, np.float32),
+                         np.full(b, res, np.float32),
+                         ((scale_h + scale_w) * 0.5).astype(np.float32)],
+                        axis=1)
+        return (self._fwd(self.variables, batch["input"], info),
+                scale_h, scale_w)
+
+    @staticmethod
+    def _rescale(dev_dets, scale_h, scale_w) -> np.ndarray:
+        """Read back + project to original pixels: x/scale_w, y/scale_h
+        (host-side numpy — the array is tiny)."""
+        dets = np.array(dev_dets)
+        dets[..., 2] /= scale_w[:, None]
+        dets[..., 4] /= scale_w[:, None]
+        dets[..., 3] /= scale_h[:, None]
+        dets[..., 5] /= scale_h[:, None]
+        return dets
+
+    def detect_batch(self, batch: Dict) -> np.ndarray:
+        """(B, max_per_image, 6) detections in ORIGINAL image pixels."""
+        return self._rescale(*self._detect_device(batch))
+
+    def predict(self, records) -> List[np.ndarray]:
+        """records: iterable of SSDByteRecord → per-image (K, 6) arrays
+        ``(class, score, x1, y1, x2, y2)`` in original pixel coords."""
+        return run_serving_loop(serving_chain(self.param)(records),
+                                self._detect_device,
+                                lambda t: self._rescale(*t))

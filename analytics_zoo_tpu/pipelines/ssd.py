@@ -12,6 +12,7 @@ per-epoch validation/checkpoint/summaries).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import logging
 import time
 from typing import Dict, List, Optional, Sequence
@@ -251,8 +252,10 @@ class SSDPredictor:
         self.post = post or DetectionOutputParam(n_classes=n_classes)
         priors, variances = build_priors(
             ssd300_config() if param.resolution == 300 else ssd512_config())
-        self._priors = jnp.asarray(priors)
-        self._variances = jnp.asarray(variances)
+        # host numpy on purpose: closing a COMMITTED device array into the
+        # jitted _detect degrades the remote-TPU transfer path process-wide
+        self._priors = np.asarray(priors)
+        self._variances = np.asarray(variances)
         # quantized mode snapshots int8 weights and drops the Model
         # reference so the caller CAN release the fp32 tree (otherwise the
         # 4x HBM saving never materializes); fp32 mode reads
@@ -275,36 +278,90 @@ class SSDPredictor:
         self.post = dataclasses.replace(self.post, keep_topk=k)
         return self
 
+    @functools.cached_property
+    def _detect(self):
+        """ONE jitted program for forward + softmax + DetectionOutput +
+        rescale.  A remote accelerator pays a fixed round-trip per
+        dispatch, so serving must be a single call per batch, not a chain
+        of eager ops (the in-graph-DetectionOutput philosophy the
+        reference applies by making post-processing a model layer,
+        ``SSDGraph.scala``)."""
+        eval_step = self._eval_step
+        priors, variances = self._priors, self._variances
+
+        def detect(variables, inputs, h, w, post):
+            loc, conf = eval_step(variables, inputs)
+            probs = jax.nn.softmax(conf, axis=-1)
+            dets = detection_output(loc, probs, priors, variances, post)
+            return scale_detections(dets, h, w)
+
+        return jax.jit(detect, static_argnums=(4,))
+
     def detect_normalized(self, inputs) -> jnp.ndarray:
         """Forward + softmax + DetectionOutput → (B, K, 6) normalized-box
         detections (shared by predict and Validator so serving and eval
         can't diverge)."""
         variables = (self._variables if self._variables is not None
                      else self.model.variables)
-        loc, conf = self._eval_step(variables, jnp.asarray(inputs))
-        probs = jax.nn.softmax(conf, axis=-1)
-        return detection_output(loc, probs, self._priors, self._variances,
-                                self.post)
+        ones = jnp.ones((inputs.shape[0],), jnp.float32)
+        return self._detect(variables, jnp.asarray(inputs), ones, ones,
+                            self.post)
 
-    def detect_batch(self, batch: Dict) -> np.ndarray:
-        dets = self.detect_normalized(batch["input"])
+    def _detect_device(self, batch: Dict) -> jnp.ndarray:
+        """Dispatch one batch; returns the (B, K, 6) device array WITHOUT
+        forcing a host sync (jax dispatch is async — callers can overlap
+        the next batch's host prep with this one's device execution)."""
+        variables = (self._variables if self._variables is not None
+                     else self.model.variables)
         # rescale normalized boxes to ORIGINAL pixel sizes: im_info rows are
         # (h, w, scale_h, scale_w); original = current / scale
         h = batch["im_info"][:, 0] / np.maximum(batch["im_info"][:, 2], 1e-8)
         w = batch["im_info"][:, 1] / np.maximum(batch["im_info"][:, 3], 1e-8)
-        return np.asarray(scale_detections(dets, h, w))
+        return self._detect(variables, jnp.asarray(batch["input"]),
+                            jnp.asarray(h), jnp.asarray(w), self.post)
+
+    def detect_batch(self, batch: Dict) -> np.ndarray:
+        return np.asarray(self._detect_device(batch))
 
     def predict(self, records) -> List[np.ndarray]:
         """records: iterable of SSDByteRecord → per-image (K, 6) arrays."""
-        chain = (_maybe_parallel(val_transformer(self.param),
-                                 self.param.num_workers)
-                 >> RoiImageToBatch(self.param.batch_size, keep_label=False,
-                                    drop_remainder=False))
-        out: List[np.ndarray] = []
-        for batch in chain(records):
-            dets = self.detect_batch(batch)
-            out.extend(dets[i] for i in range(dets.shape[0]))
-        return out
+        return run_serving_loop(serving_chain(self.param)(records),
+                                self._detect_device, np.asarray)
+
+
+def serving_chain(param: PreProcessParam):
+    """The shared serving preprocess chain (reference ``SSDPredictor.
+    scala:55-60``): val transformer + unlabeled batching."""
+    return (_maybe_parallel(val_transformer(param), param.num_workers)
+            >> RoiImageToBatch(param.batch_size, keep_label=False,
+                               drop_remainder=False))
+
+
+def run_serving_loop(batches, dispatch, readback,
+                     max_inflight: int = 4) -> List[np.ndarray]:
+    """Bounded-window overlap of host prep / device execution / readback.
+
+    ``dispatch(batch)`` must be async (a jit call), ``readback(token)``
+    forces the result to host.  Up to ``max_inflight`` batches are in
+    flight, so the remote device's fixed per-call latency overlaps with
+    the next batches' host prep WITHOUT letting the whole dataset's input
+    buffers accumulate in HBM."""
+    from collections import deque
+
+    pending: "deque" = deque()
+    out: List[np.ndarray] = []
+
+    def drain_one():
+        arr = readback(pending.popleft())
+        out.extend(arr[i] for i in range(arr.shape[0]))
+
+    for batch in batches:
+        pending.append(dispatch(batch))
+        if len(pending) >= max_inflight:
+            drain_one()
+    while pending:
+        drain_one()
+    return out
 
 
 class Validator:
@@ -353,8 +410,10 @@ class SSDMeanAveragePrecision:
         self.post = post or DetectionOutputParam(n_classes=n_classes)
         priors, variances = build_priors(
             ssd300_config() if resolution == 300 else ssd512_config())
-        self._priors = jnp.asarray(priors)
-        self._variances = jnp.asarray(variances)
+        # host numpy (see SSDPredictor: device-array constants poison the
+        # remote-TPU transfer path)
+        self._priors = np.asarray(priors)
+        self._variances = np.asarray(variances)
         self.name = self.inner.name
 
     def __call__(self, output, batch) -> "DetectionResult | MultiIoUResult":

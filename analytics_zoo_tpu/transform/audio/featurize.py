@@ -137,16 +137,16 @@ def make_featurizer_device(segment_samples: int,
     window = np.hanning(WINDOW_SIZE).astype(np.float32)
     fb = mel_filterbank_matrix(n_mels, WINDOW_SIZE)
 
-    idx_j = jnp.asarray(idx)
-    window_j = jnp.asarray(window)
-    fb_j = jnp.asarray(fb)
+    # keep the gather map / window / filterbank as HOST numpy: eagerly
+    # committing them and closing them into `run` would degrade the
+    # remote-TPU (axon) transfer path; jit embeds numpy constants safely
 
     @jax.jit
     def run(samples, n_valid):
         samples = jnp.asarray(samples, jnp.float32)
-        frames = samples[:, idx_j] * window_j              # (B, n, W)
+        frames = samples[:, idx] * window                  # (B, n, W)
         spec = jnp.abs(jnp.fft.rfft(frames, axis=-1))      # (B, n, W//2+1)
-        mel = jnp.log(jnp.maximum(spec @ fb_j, 1e-10))     # (B, n, n_mels)
+        mel = jnp.log(jnp.maximum(spec @ fb, 1e-10))       # (B, n, n_mels)
         frames_valid = jnp.maximum(
             (jnp.asarray(n_valid, jnp.int32) - WINDOW_SIZE)
             // WINDOW_STRIDE + 1, 0)                       # (B,)

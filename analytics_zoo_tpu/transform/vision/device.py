@@ -332,7 +332,9 @@ def make_device_augment(param: DeviceAugParam, compute_dtype=None):
     import jax
     import jax.numpy as jnp
 
-    means = jnp.asarray(param.pixel_means, jnp.float32)
+    # host numpy on purpose: an eagerly-committed device array closed
+    # into the jitted augment degrades the remote-TPU transfer path
+    means = np.asarray(param.pixel_means, np.float32)
     res = param.resolution
 
     def one(canvas, rect, size, flip, jitter):

@@ -21,12 +21,10 @@ import time
 import numpy as np
 
 
-BGR_MEANS = np.array([102.9801, 115.9465, 122.7717], np.float32)  # py-faster-rcnn
-VOC_CLASSES = (
-    "__background__", "aeroplane", "bicycle", "bird", "boat", "bottle",
-    "bus", "car", "cat", "chair", "cow", "diningtable", "dog", "horse",
-    "motorbike", "person", "pottedplant", "sheep", "sofa", "train",
-    "tvmonitor")
+from analytics_zoo_tpu.pipelines.frcnn import FRCNN_BGR_MEANS
+from analytics_zoo_tpu.pipelines.voc import VOC_CLASSES
+
+BGR_MEANS = np.asarray(FRCNN_BGR_MEANS, np.float32)
 
 
 def load_images(image_dir: str, size: int):
