@@ -17,10 +17,10 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
-from analytics_zoo_tpu.models.faster_rcnn import FasterRcnnDetector, FrcnnParam
-from analytics_zoo_tpu.ops.frcnn import FrcnnPostParam
+from analytics_zoo_tpu.models.faster_rcnn import FasterRcnnDetector
 from analytics_zoo_tpu.pipelines.ssd import (
     PreProcessParam,
     run_serving_loop,
@@ -45,8 +45,16 @@ class FrcnnPredictor:
         self.variables = variables
         self.param = param or PreProcessParam(
             resolution=512, pixel_means=FRCNN_BGR_MEANS)
-        self._fwd = jax.jit(
-            lambda v, x, info: detector.apply(v, x, info))
+        means = np.asarray(self.param.pixel_means, np.float32)
+
+        def fwd(v, x, info):
+            if x.dtype == jnp.uint8:
+                # uint8 staging path: normalize on device (4× fewer
+                # host→device bytes than float32 staging)
+                x = x.astype(jnp.float32) - means
+            return detector.apply(v, x, info)
+
+        self._fwd = jax.jit(fwd)
 
     def _detect_device(self, batch: Dict):
         """Dispatch one batch (async); returns (device detections,
@@ -82,6 +90,6 @@ class FrcnnPredictor:
     def predict(self, records) -> List[np.ndarray]:
         """records: iterable of SSDByteRecord → per-image (K, 6) arrays
         ``(class, score, x1, y1, x2, y2)`` in original pixel coords."""
-        return run_serving_loop(serving_chain(self.param)(records),
-                                self._detect_device,
-                                lambda t: self._rescale(*t))
+        return run_serving_loop(
+            serving_chain(self.param, uint8=True)(records),
+            self._detect_device, lambda t: self._rescale(*t))

@@ -270,7 +270,10 @@ def bench_detection_output_backends(args):
         f = jax.jit(lambda l, c, p=p: detection_output(
             l, c, jnp.asarray(priors), jnp.asarray(variances), p))
         o = f(loc, conf)
-        jax.block_until_ready(o)               # compile + correctness run
+        np.asarray(o)     # warmup fence: compile + drain (block_until_ready
+        #                   under-waits on the relay); inputs are already
+        #                   device-committed so the timed window that
+        #                   follows contains no host→device transfers
         t0 = time.perf_counter()
         for _ in range(args.nms_iters):
             o = f(loc, conf)
@@ -437,9 +440,11 @@ def main() -> int:
                 resolution=args.res, num_shards=8, seed=0)
             records = list(read_ssd_records(shards))
 
-        # within one process, transfer-sensitive train benches still run
-        # before readback-heavy ones (see the fence note in
-        # bench_ssd_train) — relevant for --no-isolate runs
+        # --no-isolate caveat: phases share one process, and the first
+        # phase's readback fence degrades the transfer path for all that
+        # follow (documented pathology #1) — their numbers will be
+        # understated.  Use --no-isolate only for debugging; the default
+        # subprocess-per-phase mode is the honest configuration.
         headline = None
         if "ssd_train" not in skip:
             headline = bench_ssd_train(args, mesh, pattern, device_aug=True)

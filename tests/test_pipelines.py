@@ -278,6 +278,41 @@ def test_ssd_predictor_end_to_end(tmp_path):
         assert valid[:, 2:].max() <= 80 + 1e-3
 
 
+def test_uint8_serving_chain_matches_float_chain(tmp_path):
+    """The uint8 staging chain (decode→resize→uint8 batch + in-graph
+    normalize) must equal the float chain (MatToFloats on host) when no
+    resize interpolation is involved — images already at resolution."""
+    import cv2
+
+    from analytics_zoo_tpu.pipelines.ssd import serving_chain
+
+    rng = np.random.RandomState(7)
+    recs = []
+    for i in range(2):
+        img = (rng.rand(300, 300, 3) * 255).astype(np.uint8)
+        ok, buf = cv2.imencode(".png", img)      # lossless: exact pixels
+        assert ok
+        recs.append(SSDByteRecord(data=buf.tobytes(), path=f"p{i}"))
+
+    param = PreProcessParam(batch_size=2, resolution=300)
+    model = Model(SSDVgg(num_classes=4, resolution=300))
+    model.build(0, jnp.zeros((1, 300, 300, 3)))
+    pred = SSDPredictor(model, param, n_classes=4).set_top_k(8)
+
+    u8_batches = list(serving_chain(param, uint8=True)(recs))
+    f32_batches = list(serving_chain(param, uint8=False)(recs))
+    assert u8_batches[0]["input"].dtype == np.uint8
+    assert f32_batches[0]["input"].dtype == np.float32
+    # device-side normalize == host MatToFloats on identical pixels
+    means = np.asarray(param.pixel_means, np.float32)
+    np.testing.assert_allclose(
+        u8_batches[0]["input"].astype(np.float32) - means,
+        f32_batches[0]["input"], atol=1e-5)
+    d_u8 = pred.detect_batch(u8_batches[0])
+    d_f32 = pred.detect_batch(f32_batches[0])
+    np.testing.assert_allclose(d_u8, d_f32, atol=1e-4)
+
+
 # ---------------------------------------------------------------------------
 # DS2 pipeline
 # ---------------------------------------------------------------------------
