@@ -122,8 +122,14 @@ def make_train_step(
     skip_loss_above: Optional[float] = None,
     compute_dtype=None,
     grad_accum: int = 1,
+    device_transform: Optional[Callable] = None,
 ):
     """Build the jitted train step.
+
+    ``device_transform`` (optional) is fused INTO the compiled step: the
+    batch passes through it on-device before the loss (used for the
+    device-side augmentation path — halves per-step dispatches and
+    avoids materializing the transformed batch in HBM between calls).
 
     ``skip_loss_above`` reproduces MultiBoxLoss's gradient-explosion guard
     (reference ``common/nn/MultiBoxLoss.scala:546``: skip backward when
@@ -215,6 +221,14 @@ def make_train_step(
                 mut, loss_sum * inv)
 
     def step_fn(state: TrainState, batch, lr_scale):
+        if device_transform is not None:
+            # fused in-graph (e.g. the device-side augmentation): ONE
+            # compiled program and one dispatch per step instead of
+            # transform + step as separate calls — a jitted transform
+            # passed here simply inlines during tracing.  stop_gradient
+            # marks the batch constant w.r.t. params so autodiff/remat
+            # never recomputes the transform in the backward pass.
+            batch = jax.lax.stop_gradient(device_transform(batch))
         rng, new_rng = jax.random.split(jax.random.fold_in(state.rng, state.step))
         grads, new_model_state, loss = _grads(
             state.params, state.model_state, batch, rng)
@@ -483,6 +497,7 @@ class Optimizer:
             grad_clip_norm=self.grad_clip_norm,
             compute_dtype=self.compute_dtype,
             grad_accum=self.grad_accum,
+            device_transform=self.device_transform,
         )
         eval_step = make_eval_step(self.model.module,
                                    compute_dtype=self.compute_dtype)
@@ -510,8 +525,7 @@ class Optimizer:
                     n = _batch_size(batch)
                     dev_batch = (batch if self.prefetch
                                  else mesh_lib.shard_batch(batch, self.mesh))
-                    if self.device_transform is not None:
-                        dev_batch = self.device_transform(dev_batch)
+                    # device_transform is fused INSIDE train_step
                     state, metrics = train_step(state, dev_batch,
                                                 self.optim.lr_scale)
                     loop.iteration += 1

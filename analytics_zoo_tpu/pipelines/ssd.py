@@ -121,10 +121,15 @@ class RoiImageToBatch(Transformer):
         self.keep_label = keep_label
         self.drop_remainder = drop_remainder
 
+    def _usable(self, f: ImageFeature) -> bool:
+        # invalid features stay in the batch ONLY once MatToFloats has
+        # zero-filled them — callers' outputs stay index-aligned
+        return f.is_valid or f.get("floats") is not None
+
     def apply_iter(self, it):
         buf: List[ImageFeature] = []
         for f in it:
-            if not f.is_valid and f.get("floats") is None:
+            if not self._usable(f):
                 continue
             buf.append(f)
             if len(buf) == self.batch_size:
@@ -197,8 +202,13 @@ def load_train_set_device(pattern: str, param: PreProcessParam,
                           aug: Optional["DeviceAugParam"] = None):
     """Device-augmentation train path (``transform/vision/device.py``):
     host does decode + geometry/label math; all pixel work runs on-chip.
-    Returns (DataSet of staging batches, jitted augment fn) — apply the
-    fn to each batch *after* ``device_prefetch``."""
+    Returns (DataSet of staging batches, jitted augment fn).
+
+    Supported usage: pass the augment fn as ``device_transform=`` to the
+    ``Optimizer`` / ``make_train_step`` so it FUSES into the compiled
+    train step (one dispatch per iteration).  Applying it manually per
+    batch also works (e.g. for inspection) but costs an extra dispatch —
+    don't do both."""
     from analytics_zoo_tpu.transform.vision import (DeviceAugBatch,
                                                     DeviceAugParam,
                                                     DeviceAugPrepare,
@@ -338,35 +348,38 @@ class SSDPredictor:
             self._detect_device, np.asarray)
 
 
-class Uint8ToBatch(Transformer):
+class Uint8ToBatch(RoiImageToBatch):
     """Serving-path batcher: stacks RESIZED uint8 mats + im_info.
 
     Staging uint8 instead of mean-subtracted float32 sends 4× fewer
     host→device bytes — decisive on a remote accelerator whose transfer
     path is latency/bandwidth constrained; the cast + mean-subtract runs
-    inside the jitted serving program (``SSDPredictor._detect``)."""
+    inside the jitted serving program (``SSDPredictor._detect``).
 
-    def __init__(self, batch_size: int, drop_remainder: bool = False):
-        self.batch_size = batch_size
-        self.drop_remainder = drop_remainder
+    Invalid (decode-failed) records become zero images so predict()
+    outputs stay index-aligned with the input records — the same
+    contract ``MatToFloats`` gives the float chain (reference
+    ``Convertor.scala:74-84``)."""
 
-    def apply_iter(self, it):
-        buf: List[ImageFeature] = []
-        for f in it:
-            if not f.is_valid or f.mat is None:
-                continue
-            buf.append(f)
-            if len(buf) == self.batch_size:
-                yield self.collate(buf)
-                buf = []
-        if buf and not self.drop_remainder:
-            yield self.collate(buf)
+    def __init__(self, batch_size: int, resolution: int,
+                 drop_remainder: bool = False):
+        super().__init__(batch_size, keep_label=False,
+                         drop_remainder=drop_remainder)
+        self.resolution = resolution
+
+    def _usable(self, f: ImageFeature) -> bool:
+        return True                     # invalid → zero image in collate
 
     def collate(self, feats: Sequence[ImageFeature]) -> Dict:
-        return {
-            "input": np.stack([f.mat for f in feats]),        # uint8 NHWC
-            "im_info": np.stack([f.get_im_info() for f in feats]),
-        }
+        res = self.resolution
+        zero = np.zeros((res, res, 3), np.uint8)
+        default_info = np.array([res, res, 1.0, 1.0], np.float32)
+        mats, infos = [], []
+        for f in feats:
+            ok = f.is_valid and f.mat is not None
+            mats.append(f.mat if ok else zero)
+            infos.append(f.get_im_info() if ok else default_info)
+        return {"input": np.stack(mats), "im_info": np.stack(infos)}
 
 
 def serving_chain(param: PreProcessParam, uint8: bool = False):
@@ -379,7 +392,7 @@ def serving_chain(param: PreProcessParam, uint8: bool = False):
         chain = (RecordToFeature() >> BytesToMat(to_float=False)
                  >> Resize(param.resolution, param.resolution))
         return (_maybe_parallel(chain, param.num_workers)
-                >> Uint8ToBatch(param.batch_size))
+                >> Uint8ToBatch(param.batch_size, param.resolution))
     return (_maybe_parallel(val_transformer(param), param.num_workers)
             >> RoiImageToBatch(param.batch_size, keep_label=False,
                                drop_remainder=False))

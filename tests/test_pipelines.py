@@ -278,6 +278,34 @@ def test_ssd_predictor_end_to_end(tmp_path):
         assert valid[:, 2:].max() <= 80 + 1e-3
 
 
+def test_uint8_chain_keeps_corrupt_records_aligned():
+    """A corrupt record must yield a zero image, not silently vanish —
+    predict() outputs stay index-aligned with input records (the float
+    chain's MatToFloats contract, reference ``Convertor.scala:74-84``)."""
+    import cv2
+
+    from analytics_zoo_tpu.pipelines.ssd import serving_chain
+
+    rng = np.random.RandomState(3)
+    img = (rng.rand(64, 64, 3) * 255).astype(np.uint8)
+    ok, buf = cv2.imencode(".jpg", img)
+    assert ok
+    recs = [
+        SSDByteRecord(data=buf.tobytes(), path="good0"),
+        SSDByteRecord(data=b"not a jpeg at all", path="corrupt"),
+        SSDByteRecord(data=buf.tobytes(), path="good1"),
+    ]
+    param = PreProcessParam(batch_size=2, resolution=64)
+    batches = list(serving_chain(param, uint8=True)(recs))
+    total = sum(b["input"].shape[0] for b in batches)
+    assert total == 3
+    # the corrupt slot is a zero image with default im_info
+    assert (batches[0]["input"][1] == 0).all()
+    np.testing.assert_allclose(batches[0]["im_info"][1],
+                               [64, 64, 1.0, 1.0])
+    assert (batches[0]["input"][0] != 0).any()
+
+
 def test_uint8_serving_chain_matches_float_chain(tmp_path):
     """The uint8 staging chain (decode→resize→uint8 batch + in-graph
     normalize) must equal the float chain (MatToFloats on host) when no
