@@ -398,30 +398,35 @@ def serving_chain(param: PreProcessParam, uint8: bool = False):
                                drop_remainder=False))
 
 
-def run_serving_loop(batches, dispatch, readback,
-                     max_inflight: int = 4) -> List[np.ndarray]:
+def overlap_window(items, dispatch, consume, max_inflight: int = 4) -> None:
     """Bounded-window overlap of host prep / device execution / readback.
 
-    ``dispatch(batch)`` must be async (a jit call), ``readback(token)``
-    forces the result to host.  Up to ``max_inflight`` batches are in
-    flight, so the remote device's fixed per-call latency overlaps with
-    the next batches' host prep WITHOUT letting the whole dataset's input
-    buffers accumulate in HBM."""
+    ``dispatch(item)`` must be async (a jit call returning a token);
+    ``consume(token)`` forces the result to host and processes it.  Up to
+    ``max_inflight`` items are in flight, so the remote device's fixed
+    per-call latency overlaps with the next items' host prep WITHOUT
+    letting the whole dataset's input buffers accumulate in HBM."""
     from collections import deque
 
     pending: "deque" = deque()
+    for item in items:
+        pending.append(dispatch(item))
+        if len(pending) >= max_inflight:
+            consume(pending.popleft())
+    while pending:
+        consume(pending.popleft())
+
+
+def run_serving_loop(batches, dispatch, readback,
+                     max_inflight: int = 4) -> List[np.ndarray]:
+    """``overlap_window`` specialized to collecting per-image arrays."""
     out: List[np.ndarray] = []
 
-    def drain_one():
-        arr = readback(pending.popleft())
+    def consume(token):
+        arr = readback(token)
         out.extend(arr[i] for i in range(arr.shape[0]))
 
-    for batch in batches:
-        pending.append(dispatch(batch))
-        if len(pending) >= max_inflight:
-            drain_one()
-    while pending:
-        drain_one()
+    overlap_window(batches, dispatch, consume, max_inflight)
     return out
 
 
@@ -440,11 +445,21 @@ class Validator:
         total: Optional[DetectionResult] = None
         n_records = 0
         t0 = time.time()
-        for batch in dataset:
-            dets = self.predictor.detect_normalized(batch["input"])
+
+        def dispatch(batch):
+            nonlocal n_records
+            n_records += batch["input"].shape[0]
+            return self.predictor.detect_normalized(batch["input"]), batch
+
+        def consume(token):
+            nonlocal total
+            dets, batch = token
             r = self.evaluator(np.asarray(dets), batch)
             total = r if total is None else total + r
-            n_records += batch["input"].shape[0]
+
+        # dispatch-ahead window: the next batches' forwards overlap this
+        # one's readback + host-side eval
+        overlap_window(dataset, dispatch, consume)
         dt = time.time() - t0
         logger.info("[Prediction] %d in %.2f seconds. Throughput is %.2f "
                     "records/sec", n_records, dt, n_records / max(dt, 1e-9))

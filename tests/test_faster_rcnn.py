@@ -110,6 +110,38 @@ def test_caffe_weight_import_roundtrip():
         np.asarray(new["rpn_conv_3x3"]["kernel"]), 0.5)
 
 
+def test_frcnn_predictor_end_to_end():
+    """SSDByteRecord stream → FrcnnPredictor → original-pixel detections
+    (reference ``Predict.scala`` serving with ``FrcnnCaffeLoader``)."""
+    import cv2
+
+    from analytics_zoo_tpu.data.records import SSDByteRecord
+    from analytics_zoo_tpu.pipelines import FrcnnPredictor
+    from analytics_zoo_tpu.pipelines.ssd import PreProcessParam
+
+    rng = np.random.RandomState(0)
+    records = []
+    orig = 96                                     # != resolution: rescale path
+    for i in range(3):
+        img = (rng.rand(orig, orig, 3) * 255).astype(np.uint8)
+        ok, buf = cv2.imencode(".jpg", img)
+        assert ok
+        records.append(SSDByteRecord(data=buf.tobytes(), path=f"r{i}"))
+
+    det = FasterRcnnDetector(param=PARAM)
+    x = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    variables = det.init(jax.random.PRNGKey(0), x, _im_info(1, 64))
+    pred = FrcnnPredictor(det, variables,
+                          PreProcessParam(batch_size=2, resolution=64))
+    out = pred.predict(records)
+    assert len(out) == 3
+    for dets in out:
+        assert dets.shape == (det.post.max_per_image, 6)
+        kept = dets[dets[:, 1] > 0]
+        if kept.size:                              # original-pixel range
+            assert (kept[:, 2:] >= 0).all() and (kept[:, 2:] <= orig).all()
+
+
 def test_fc6_chw_layout_fixup(tmp_path):
     """fc6's Caffe weight rows are ordered over a CHW flatten; the import
     path must permute them to this framework's HWC flatten so
