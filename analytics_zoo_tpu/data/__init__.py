@@ -28,7 +28,9 @@ from analytics_zoo_tpu.data.records import (
     shard_paths,
     write_ssd_records,
 )
-from analytics_zoo_tpu.data.prefetch import PrefetchDataSet, device_prefetch
+from analytics_zoo_tpu.data.prefetch import (PrefetchDataSet,
+                                             device_prefetch,
+                                             overlap_window)
 from analytics_zoo_tpu.data.synthetic import (
     SHAPE_CLASSES,
     generate_shapes_records,

@@ -84,3 +84,23 @@ class PrefetchDataSet:
 
     def __len__(self):
         return len(self.dataset)
+
+
+def overlap_window(items, dispatch, consume, max_inflight: int = 4) -> None:
+    """Bounded-window overlap of host prep / device execution / readback.
+
+    ``dispatch(item)`` must be async (a jit call returning a token);
+    ``consume(token)`` forces the result to host and processes it.  Up to
+    ``max_inflight`` items are in flight, so the remote device's fixed
+    per-call latency overlaps with the next items' host prep WITHOUT
+    letting the whole dataset's input buffers accumulate in HBM.  Used by
+    the serving predictors, the Validator, and the ASR pipeline."""
+    from collections import deque
+
+    pending: "deque" = deque()
+    for item in items:
+        pending.append(dispatch(item))
+        if len(pending) >= max_inflight:
+            consume(pending.popleft())
+    while pending:
+        consume(pending.popleft())

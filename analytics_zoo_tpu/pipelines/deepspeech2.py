@@ -102,33 +102,74 @@ class DeepSpeech2Pipeline:
         self.vocab_decoder = (VocabDecoder(param.vocab)
                               if param.vocab else None)
         self._dev_featurizer = None      # built lazily per segment size
+        self._fused_asr = None           # featurize→forward→argmax, one jit
+        # the fused single-program path covers the standard forward; the
+        # sequence-parallel forward keeps the split pipeline
+        self._fused_ok = sequence_mesh is None
+
+    def _make_featurizer(self):
+        """The ONE construction site for the device featurizer — both the
+        split path and the fused greedy program must featurize
+        identically."""
+        if self._dev_featurizer is None:
+            from analytics_zoo_tpu.transform.audio import (
+                make_featurizer_device)
+
+            self._dev_featurizer = make_featurizer_device(
+                self.segmenter.segment_size, utt_length=self.utt_length,
+                n_mels=self.param.n_mels)
+        return self._dev_featurizer
+
+    def _pack_batch(self, chunk: List[dict]):
+        """Zero-pad a chunk of segments to one fixed (batch_size,
+        segment_samples) array + per-row valid sample counts — the
+        shared packing contract of the split and fused paths."""
+        bs = self.param.batch_size
+        seg_samples = self.segmenter.segment_size
+        batch = np.zeros((bs, seg_samples), np.float32)
+        n_valid = np.zeros((bs,), np.int32)
+        for i, s in enumerate(chunk):
+            x = s["samples"]
+            batch[i, :len(x)] = x
+            n_valid[i] = len(x)
+        return batch, n_valid
 
     def _featurize_device(self, segments: List[dict]) -> np.ndarray:
         """Featurize in fixed ``batch_size`` device batches (last one
         zero-padded) with host-parity frame masking — one static shape,
         so exactly one XLA compile and bounded device memory regardless
         of how many segments a call carries."""
-        from analytics_zoo_tpu.transform.audio import make_featurizer_device
-
-        seg_samples = self.segmenter.segment_size
-        if self._dev_featurizer is None:
-            self._dev_featurizer = make_featurizer_device(
-                seg_samples, utt_length=self.utt_length,
-                n_mels=self.param.n_mels)
+        featurizer = self._make_featurizer()
         bs = self.param.batch_size
         out = np.zeros((len(segments), self.utt_length, self.param.n_mels),
                        np.float32)
         for start in range(0, len(segments), bs):
             chunk = segments[start:start + bs]
-            batch = np.zeros((bs, seg_samples), np.float32)
-            n_valid = np.zeros((bs,), np.int32)
-            for i, s in enumerate(chunk):
-                x = s["samples"]
-                batch[i, :len(x)] = x
-                n_valid[i] = len(x)
+            batch, n_valid = self._pack_batch(chunk)
             out[start:start + len(chunk)] = np.asarray(
-                self._dev_featurizer(batch, n_valid))[:len(chunk)]
+                featurizer(batch, n_valid))[:len(chunk)]
         return out
+
+    def _fused_greedy(self):
+        """ONE jitted program: device featurize → DS2 forward → per-frame
+        argmax.  Features never round-trip to host (the split path reads
+        them back only to re-upload), and the readback is (B, T) int ids
+        — ~30× fewer bytes than (B, T, C) log-probs.  Serving on a
+        remote accelerator is dispatch/transfer bound, so the greedy
+        path must be a single call per batch (docs/PERFORMANCE.md)."""
+        if self._fused_asr is None:
+            import jax
+
+            feat_fn = self._make_featurizer()
+            eval_step = self._eval_step
+
+            def run(variables, samples, n_valid):
+                feats = feat_fn(samples, n_valid)
+                log_probs = eval_step(variables, feats)
+                return jnp.argmax(log_probs, axis=-1)
+
+            self._fused_asr = jax.jit(run)
+        return self._fused_asr
 
     def _decode(self, log_probs: np.ndarray) -> str:
         if self.param.decoder == "beam":
@@ -137,36 +178,64 @@ class DeepSpeech2Pipeline:
                                       beam_width=self.param.beam_width)
         return best_path_decode(log_probs)
 
+    def _transcribe_fused(self, segments: List[dict]) -> List[str]:
+        """Greedy + device-featurize fast path: one jit call per batch of
+        raw samples, bounded dispatch-ahead window, int-ids readback."""
+        from analytics_zoo_tpu.data import overlap_window
+        from analytics_zoo_tpu.transform.audio.decoders import ids_to_text
+
+        fused = self._fused_greedy()
+        bs = self.param.batch_size
+        texts: List[str] = []
+
+        def dispatch(start):
+            chunk = segments[start:start + bs]
+            batch, n_valid = self._pack_batch(chunk)
+            return fused(self.model.variables, batch, n_valid), len(chunk)
+
+        def consume(token):
+            ids, n_real = token
+            ids = np.asarray(ids)
+            texts.extend(ids_to_text(ids[j]) for j in range(n_real))
+
+        overlap_window(range(0, len(segments), bs), dispatch, consume)
+        return texts
+
     def transcribe_samples(self, utterances: Dict[str, np.ndarray]
                            ) -> Dict[str, str]:
         """{audio_id: samples} → {audio_id: transcript}."""
         segments: List[dict] = []
         for audio_id, samples in utterances.items():
             segments.extend(self.segmenter.segment(samples, audio_id))
-        if not segments:
-            feats = np.zeros((0, self.utt_length, self.param.n_mels),
-                             np.float32)
-        elif self.param.device_featurize:
-            feats = np.asarray(self._featurize_device(segments))
-        else:
-            feats = np.stack([
-                featurize(s["samples"], utt_length=self.utt_length,
-                          n_mels=self.param.n_mels)
-                for s in segments
-            ])
 
-        texts: List[str] = []
-        for i in range(0, len(segments), self.param.batch_size):
-            chunk = feats[i:i + self.param.batch_size]
-            n_real = chunk.shape[0]
-            if self._pad_to_batch and n_real < self.param.batch_size:
-                pad = np.zeros((self.param.batch_size - n_real,)
-                               + chunk.shape[1:], chunk.dtype)
-                chunk = np.concatenate([chunk, pad])
-            log_probs = self._eval_step(self.model.variables,
-                                        jnp.asarray(chunk))
-            texts.extend(self._decode(np.asarray(log_probs[j]))
-                         for j in range(n_real))
+        if segments and self._fused_ok and self.param.device_featurize \
+                and self.param.decoder == "greedy":
+            texts = self._transcribe_fused(segments)
+        else:
+            if not segments:
+                feats = np.zeros((0, self.utt_length, self.param.n_mels),
+                                 np.float32)
+            elif self.param.device_featurize:
+                feats = np.asarray(self._featurize_device(segments))
+            else:
+                feats = np.stack([
+                    featurize(s["samples"], utt_length=self.utt_length,
+                              n_mels=self.param.n_mels)
+                    for s in segments
+                ])
+
+            texts = []
+            for i in range(0, len(segments), self.param.batch_size):
+                chunk = feats[i:i + self.param.batch_size]
+                n_real = chunk.shape[0]
+                if self._pad_to_batch and n_real < self.param.batch_size:
+                    pad = np.zeros((self.param.batch_size - n_real,)
+                                   + chunk.shape[1:], chunk.dtype)
+                    chunk = np.concatenate([chunk, pad])
+                log_probs = self._eval_step(self.model.variables,
+                                            jnp.asarray(chunk))
+                texts.extend(self._decode(np.asarray(log_probs[j]))
+                             for j in range(n_real))
 
         # re-join by (audio_id, audio_seq) (reference InferenceEvaluate
         # groupBy(audio_id).sort(audio_seq) concat)

@@ -28,6 +28,7 @@ from analytics_zoo_tpu.data import (
     RandomTransformer,
     SSDByteRecord,
     Transformer,
+    overlap_window,
     pad_ragged,
 )
 from analytics_zoo_tpu.models import SSDVgg, build_priors, ssd300_config, ssd512_config
@@ -396,25 +397,6 @@ def serving_chain(param: PreProcessParam, uint8: bool = False):
     return (_maybe_parallel(val_transformer(param), param.num_workers)
             >> RoiImageToBatch(param.batch_size, keep_label=False,
                                drop_remainder=False))
-
-
-def overlap_window(items, dispatch, consume, max_inflight: int = 4) -> None:
-    """Bounded-window overlap of host prep / device execution / readback.
-
-    ``dispatch(item)`` must be async (a jit call returning a token);
-    ``consume(token)`` forces the result to host and processes it.  Up to
-    ``max_inflight`` items are in flight, so the remote device's fixed
-    per-call latency overlaps with the next items' host prep WITHOUT
-    letting the whole dataset's input buffers accumulate in HBM."""
-    from collections import deque
-
-    pending: "deque" = deque()
-    for item in items:
-        pending.append(dispatch(item))
-        if len(pending) >= max_inflight:
-            consume(pending.popleft())
-    while pending:
-        consume(pending.popleft())
 
 
 def run_serving_loop(batches, dispatch, readback,

@@ -20,18 +20,28 @@ ALPHABET = "_'ABCDEFGHIJKLMNOPQRSTUVWXYZ "
 BLANK_ID = 0
 
 
-def best_path_decode(log_probs: np.ndarray, alphabet: str = ALPHABET,
-                     blank_id: int = BLANK_ID) -> str:
-    """Greedy CTC: per-frame argmax → collapse repeats → strip blanks
-    (reference ``BestPathDecoder``)."""
-    ids = np.asarray(log_probs).argmax(axis=-1)
+def ids_to_text(ids, alphabet: str = ALPHABET,
+                blank_id: int = BLANK_ID) -> str:
+    """CTC collapse: repeat-merge + blank-strip over per-frame argmax ids.
+
+    Split out of :func:`best_path_decode` so the argmax can run ON DEVICE
+    (the fused ASR serving path reads back (T,) int ids — ~30× fewer
+    bytes than the full (T, C) log-probs)."""
     out: List[str] = []
     prev = -1
-    for i in ids:
+    for i in np.asarray(ids):
         if i != prev and i != blank_id:
             out.append(alphabet[int(i)])
         prev = i
     return "".join(out)
+
+
+def best_path_decode(log_probs: np.ndarray, alphabet: str = ALPHABET,
+                     blank_id: int = BLANK_ID) -> str:
+    """Greedy CTC: per-frame argmax → collapse repeats → strip blanks
+    (reference ``BestPathDecoder``)."""
+    return ids_to_text(np.asarray(log_probs).argmax(axis=-1),
+                       alphabet, blank_id)
 
 
 def beam_search_decode(log_probs: np.ndarray, beam_width: int = 16,

@@ -364,6 +364,24 @@ def test_ds2_pipeline_transcribe_and_rejoin():
     assert ev.wer > 0  # untrained model won't be right
 
 
+def test_ds2_fused_greedy_matches_split_path():
+    """The fused featurize→forward→argmax program must transcribe exactly
+    like the split path (device featurize, host log-probs decode)."""
+    model = make_ds2_model(hidden=32, n_rnn_layers=1, utt_length=100)
+    param = DS2Param(segment_seconds=1, batch_size=4)
+    rng = np.random.RandomState(1)
+    utts = {
+        "a": (rng.randn(int(SAMPLE_RATE * 2.3)) * 0.3).astype(np.float32),
+        "b": (rng.randn(SAMPLE_RATE) * 0.3).astype(np.float32),
+    }
+    fused_pipe = DeepSpeech2Pipeline(model, param)
+    assert fused_pipe._fused_ok
+    split_pipe = DeepSpeech2Pipeline(model, param)
+    split_pipe._fused_ok = False
+    assert fused_pipe.transcribe_samples(utts) == \
+        split_pipe.transcribe_samples(utts)
+
+
 def test_ssd_map_validation_method_on_raw_output():
     """SSDMeanAveragePrecision adapts raw (loc, conf) model output for the
     Optimizer's validation loop (decode + NMS inside the method)."""
