@@ -83,7 +83,7 @@ def bench_ssd_train(args, mesh, shard_pattern, device_aug: bool):
 
     from analytics_zoo_tpu.core.module import Model
     from analytics_zoo_tpu.data import device_prefetch
-    from analytics_zoo_tpu.models import SSDVgg, build_priors, ssd300_config
+    from analytics_zoo_tpu.models import SSDVgg, build_priors
     from analytics_zoo_tpu.ops import MultiBoxLoss, MultiBoxLossParam
     from analytics_zoo_tpu.parallel import (
         SGD, create_train_state, make_train_step, replicate)
@@ -94,7 +94,7 @@ def bench_ssd_train(args, mesh, shard_pattern, device_aug: bool):
     res = args.res
     model = Model(SSDVgg(num_classes=args.classes, resolution=res))
     model.build(0, jnp.zeros((1, res, res, 3), jnp.float32))
-    priors, variances = build_priors(ssd300_config())
+    priors, variances = build_priors(model.module.config)
     criterion = MultiBoxLoss(priors, variances,
                              MultiBoxLossParam(n_classes=args.classes))
     optim = SGD(1e-3, momentum=0.9)
@@ -173,8 +173,9 @@ def bench_ssd_train(args, mesh, shard_pattern, device_aug: bool):
         float(_np.asarray(metrics["loss"]))       # fence
         dt_step = time.perf_counter() - t0
         step_per_chip = args.batch * args.steps / dt_step / max(n_chips, 1)
-        _emit("ssd300_train_step_images_per_sec_per_chip", step_per_chip,
-              "images/sec/chip", step_per_chip / ROUND1_TRAIN_IMG_S,
+        _emit(f"ssd{res}_train_step_images_per_sec_per_chip",
+              step_per_chip, "images/sec/chip",
+              step_per_chip / ROUND1_TRAIN_IMG_S if res == 300 else None,
               note="device step only (batch re-fed) — input pipeline "
                    "excluded; vs_baseline = vs round-1 synthetic harness "
                    "(fp32→bf16)")
@@ -182,21 +183,21 @@ def bench_ssd_train(args, mesh, shard_pattern, device_aug: bool):
         peak = PEAK_TFLOPS.get(kind)
         if flops > 0:
             tflops = flops / (dt_step / args.steps) / 1e12 / max(n_chips, 1)
-            _emit("ssd300_train_model_tflops_per_chip", tflops,
+            _emit(f"ssd{res}_train_model_tflops_per_chip", tflops,
                   "TFLOP/s/chip", tflops / peak if peak else None,
                   mfu=round(tflops / peak, 4) if peak else None,
                   peak_tflops=peak, device_kind=kind,
                   note="fwd+bwd+update FLOPs from XLA compiled "
                        "cost_analysis over the compute-only step time; "
                        "vs_baseline = MFU against advertised bf16 peak")
-        _emit("ssd300_train_host_bound_fraction",
+        _emit(f"ssd{res}_train_host_bound_fraction",
               max(0.0, 1.0 - (dt_step / dt)), "fraction", None,
               host_cpus=os.cpu_count(),
               note="1 - step_time/e2e_time with device-side augmentation "
                    "(this VM exposes few host cores; a real v5e TPU-VM "
                    "host has ~112)")
     else:
-        _emit("ssd300_train_hostaug_images_per_sec_per_chip", per_chip,
+        _emit(f"ssd{res}_train_hostaug_images_per_sec_per_chip", per_chip,
               "images/sec/chip", None, host_cpus=os.cpu_count(),
               note="reference-style host (OpenCV) augmentation chain "
                    "end-to-end — compare with the device-aug headline")
@@ -234,7 +235,7 @@ def bench_ssd_serve(args, mesh, records):
         return len(records) / dt / max(jax.device_count(), 1)
 
     per_chip = _time_predict(predictor)
-    _emit("ssd300_serve_images_per_sec_per_chip", per_chip,
+    _emit(f"ssd{args.res}_serve_images_per_sec_per_chip", per_chip,
           "images/sec/chip", None,
           nms_backend="pallas" if on_tpu else "xla",  # auto-resolved
           note="decode+preprocess+forward+DetectionOutput+rescale; "
@@ -251,7 +252,7 @@ def bench_ssd_serve(args, mesh, records):
         compute_dtype=args.compute_dtype, quantize=True)
     del predictor
     per_chip_q = _time_predict(q_predictor)
-    return _emit("ssd300_serve_int8_images_per_sec_per_chip", per_chip_q,
+    return _emit(f"ssd{args.res}_serve_int8_images_per_sec_per_chip", per_chip_q,
                  "images/sec/chip", per_chip_q / max(per_chip, 1e-9),
                  note="int8 weight-only quantized serving; vs_baseline = "
                       "speedup vs the fp32/bf16 serving path above")
@@ -470,11 +471,14 @@ def main() -> int:
             bench_ds2(args, mesh)
         if headline is not None:
             per_chip, total, loss = headline
-            _emit("ssd300_train_images_per_sec_per_chip", per_chip,
-                  "images/sec/chip",
-                  total / REFERENCE_ANCHOR_IMAGES_PER_SEC,
+            _emit(f"ssd{args.res}_train_images_per_sec_per_chip",
+                  per_chip, "images/sec/chip",
+                  (total / REFERENCE_ANCHOR_IMAGES_PER_SEC
+                   if args.res == 300 else None),
                   final_loss=round(float(loss), 3),
-                  vs_round1_synthetic=round(per_chip / ROUND1_TRAIN_IMG_S, 3),
+                  vs_round1_synthetic=(
+                      round(per_chip / ROUND1_TRAIN_IMG_S, 3)
+                      if args.res == 300 else None),
                   anchor="LABELED ESTIMATE ~56 img/s: reference 4x28-core "
                          "Xeon cluster @ ~0.5 img/s/core; reference "
                          "publishes no absolute numbers (SURVEY.md §6). "
