@@ -392,6 +392,10 @@ def main() -> int:
     p.add_argument("--no-isolate", action="store_true",
                    help="run all phases in THIS process instead of one "
                         "subprocess per phase (see note in main)")
+    p.add_argument("--phase-timeout", type=int, default=2400,
+                   help="seconds per phase subprocess; a hung TPU relay "
+                        "then yields an error line instead of blocking "
+                        "the whole run forever.  <= 0 disables the limit")
     p.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     args = p.parse_args()
     if args.quick:
@@ -430,8 +434,22 @@ def main() -> int:
             child_skip = ",".join(q for q in ALL_PHASES if q != phase)
             cmd = [sys.executable, os.path.abspath(__file__), "--child",
                    "--skip", child_skip] + passthrough
-            r = subprocess.run(cmd)
-            rc = rc or r.returncode
+            limit = args.phase_timeout if args.phase_timeout > 0 else None
+            # new session so a timeout can kill the WHOLE group — a hung
+            # relay/worker grandchild would otherwise survive the child
+            # and poison every later phase
+            proc = subprocess.Popen(cmd, start_new_session=True)
+            try:
+                rc = rc or proc.wait(timeout=limit)
+            except subprocess.TimeoutExpired:
+                import signal
+
+                os.killpg(proc.pid, signal.SIGKILL)
+                proc.wait()
+                _emit(f"{phase}_error", 0.0, "none", None,
+                      error=f"phase exceeded {limit}s "
+                            "(TPU relay hang?) — killed")
+                rc = rc or 1
         return rc
 
     from analytics_zoo_tpu.data import generate_shapes_records, read_ssd_records
