@@ -6,14 +6,20 @@ per-class NMS in-graph) → detections rescaled to original image size.
 TPU-first deviation from the reference: the reference's Faster-RCNN
 preprocess is aspect-preserving ``AspectScale(600, max 1000)`` which
 yields variable input shapes (fine on CPU, a recompile per shape under
-XLA).  Serving here resizes to one fixed square resolution so every batch
-reuses a single compiled program; ``im_info`` scale factors restore
-original-size pixel boxes, exactly like the SSD path
-(``BboxUtil.scaleBatchOutput:384``).
+XLA).  Serving here keeps the reference's aspect-preserving geometry but
+inside ONE fixed square canvas (``AspectScaleCanvas``: scale the long
+side to ``resolution``, pad bottom/right) so every batch reuses a single
+compiled program; ``im_info`` scale factors restore original-size pixel
+boxes, exactly like the SSD path (``BboxUtil.scaleBatchOutput:384``).
+Pass ``aspect_preserving=False`` to use the distorting square resize
+instead (slightly fewer dead pixels, measurably worse accuracy for
+imported py-faster-rcnn weights which saw undistorted inputs).
 """
 
 from __future__ import annotations
 
+import dataclasses
+import logging
 from typing import Dict, List, Optional
 
 import jax
@@ -22,10 +28,14 @@ import numpy as np
 
 from analytics_zoo_tpu.models.faster_rcnn import FasterRcnnDetector
 from analytics_zoo_tpu.pipelines.ssd import (
+    BGR_MEANS,
     PreProcessParam,
     run_serving_loop,
     serving_chain,
 )
+from analytics_zoo_tpu.transform.vision import AspectScaleCanvas
+
+logger = logging.getLogger("analytics_zoo_tpu")
 
 # py-faster-rcnn BGR channel means (its models were trained with these,
 # not the SSD-Caffe 104/117/123)
@@ -40,11 +50,28 @@ class FrcnnPredictor:
     """
 
     def __init__(self, detector: FasterRcnnDetector, variables,
-                 param: Optional[PreProcessParam] = None):
+                 param: Optional[PreProcessParam] = None,
+                 aspect_preserving: bool = True,
+                 swap_default_means: bool = True):
         self.detector = detector
         self.variables = variables
-        self.param = param or PreProcessParam(
-            resolution=512, pixel_means=FRCNN_BGR_MEANS)
+        if param is None:
+            param = PreProcessParam(resolution=512,
+                                    pixel_means=FRCNN_BGR_MEANS)
+        elif (swap_default_means
+              and tuple(param.pixel_means) == tuple(BGR_MEANS)):
+            # caller set batch/resolution but left the SSD-Caffe default
+            # means — silently wrong for py-faster-rcnn weights; swap in
+            # the Faster-RCNN means.  A caller who genuinely wants the
+            # SSD means must pass swap_default_means=False (the values
+            # alone can't distinguish "default" from "chosen").
+            logger.info("FrcnnPredictor: replacing default SSD pixel "
+                        "means with FRCNN_BGR_MEANS "
+                        "(swap_default_means=False keeps them)")
+            param = dataclasses.replace(param,
+                                        pixel_means=FRCNN_BGR_MEANS)
+        self.param = param
+        self.aspect_preserving = aspect_preserving
         means = np.asarray(self.param.pixel_means, np.float32)
 
         def fwd(v, x, info):
@@ -59,14 +86,16 @@ class FrcnnPredictor:
     def _detect_device(self, batch: Dict):
         """Dispatch one batch (async); returns (device detections,
         scale_h, scale_w) — boxes still in resized-image pixels."""
-        b = batch["input"].shape[0]
-        res = float(self.param.resolution)
-        # detector im_info rows are (height, width, scale); min_size
-        # filtering in the proposal layer uses the scale factor
+        # detector im_info rows are (height, width, scale): height/width
+        # are the CONTENT dims — with AspectScaleCanvas the image fills
+        # only im_info[:2] of the canvas, and the in-graph clip
+        # (``clip_boxes``) must clip to the valid region, not the canvas,
+        # or pad-region boxes rescale to out-of-bounds original pixels;
+        # min_size filtering in the proposal layer uses the scale factor
         scale_h = np.maximum(batch["im_info"][:, 2], 1e-8)
         scale_w = np.maximum(batch["im_info"][:, 3], 1e-8)
-        info = np.stack([np.full(b, res, np.float32),
-                         np.full(b, res, np.float32),
+        info = np.stack([batch["im_info"][:, 0].astype(np.float32),
+                         batch["im_info"][:, 1].astype(np.float32),
                          ((scale_h + scale_w) * 0.5).astype(np.float32)],
                         axis=1)
         return (self._fwd(self.variables, batch["input"], info),
@@ -90,6 +119,8 @@ class FrcnnPredictor:
     def predict(self, records) -> List[np.ndarray]:
         """records: iterable of SSDByteRecord → per-image (K, 6) arrays
         ``(class, score, x1, y1, x2, y2)`` in original pixel coords."""
+        resize = (AspectScaleCanvas(self.param.resolution)
+                  if self.aspect_preserving else None)
         return run_serving_loop(
-            serving_chain(self.param, uint8=True)(records),
+            serving_chain(self.param, uint8=True, resize=resize)(records),
             self._detect_device, lambda t: self._rescale(*t))

@@ -371,6 +371,31 @@ class Uint8ToBatch(RoiImageToBatch):
     def _usable(self, f: ImageFeature) -> bool:
         return True                     # invalid → zero image in collate
 
+    def apply_iter(self, it):
+        # A final partial batch would be a NEW shape — one extra XLA
+        # compile of the whole fused serving program per distinct
+        # remainder size (minutes on a cold cache).  Pad it to
+        # ``batch_size`` with zero images (the existing invalid-record
+        # convention) and record the true count; ``run_serving_loop``
+        # slices the outputs back.
+        for batch in super().apply_iter(it):
+            n = batch["input"].shape[0]
+            if n < self.batch_size:
+                pad = self.batch_size - n
+                batch = {
+                    "input": np.concatenate(
+                        [batch["input"],
+                         np.zeros((pad,) + batch["input"].shape[1:],
+                                  batch["input"].dtype)]),
+                    "im_info": np.concatenate(
+                        [batch["im_info"],
+                         np.tile(np.array([[self.resolution,
+                                            self.resolution, 1.0, 1.0]],
+                                          np.float32), (pad, 1))]),
+                    "n_valid": n,
+                }
+            yield batch
+
     def collate(self, feats: Sequence[ImageFeature]) -> Dict:
         res = self.resolution
         zero = np.zeros((res, res, 3), np.uint8)
@@ -383,15 +408,20 @@ class Uint8ToBatch(RoiImageToBatch):
         return {"input": np.stack(mats), "im_info": np.stack(infos)}
 
 
-def serving_chain(param: PreProcessParam, uint8: bool = False):
+def serving_chain(param: PreProcessParam, uint8: bool = False,
+                  resize: Optional[Transformer] = None):
     """The shared serving preprocess chain (reference ``SSDPredictor.
     scala:55-60``): val transformer + unlabeled batching.
 
     ``uint8=True`` keeps pixels uint8 end-to-end on the host (decode →
-    resize → stack) and defers normalize to the device program."""
+    resize → stack) and defers normalize to the device program.
+    ``resize`` overrides the square ``Resize`` (e.g. Faster-RCNN's
+    aspect-preserving ``AspectScaleCanvas``) — it must still emit mats of
+    exactly ``param.resolution``² so every batch shares one shape."""
     if uint8:
         chain = (RecordToFeature() >> BytesToMat(to_float=False)
-                 >> Resize(param.resolution, param.resolution))
+                 >> (resize if resize is not None
+                     else Resize(param.resolution, param.resolution)))
         return (_maybe_parallel(chain, param.num_workers)
                 >> Uint8ToBatch(param.batch_size, param.resolution))
     return (_maybe_parallel(val_transformer(param), param.num_workers)
@@ -401,14 +431,22 @@ def serving_chain(param: PreProcessParam, uint8: bool = False):
 
 def run_serving_loop(batches, dispatch, readback,
                      max_inflight: int = 4) -> List[np.ndarray]:
-    """``overlap_window`` specialized to collecting per-image arrays."""
+    """``overlap_window`` specialized to collecting per-image arrays.
+
+    Honors the padded-final-batch convention (``Uint8ToBatch``): a batch
+    carrying ``n_valid`` yields only its first ``n_valid`` rows."""
     out: List[np.ndarray] = []
 
-    def consume(token):
-        arr = readback(token)
-        out.extend(arr[i] for i in range(arr.shape[0]))
+    def dispatch_sliced(batch):
+        n = batch.pop("n_valid", None) if isinstance(batch, dict) else None
+        return dispatch(batch), n
 
-    overlap_window(batches, dispatch, consume, max_inflight)
+    def consume(token):
+        tok, n = token
+        arr = readback(tok)
+        out.extend(arr[i] for i in range(arr.shape[0] if n is None else n))
+
+    overlap_window(batches, dispatch_sliced, consume, max_inflight)
     return out
 
 

@@ -11,6 +11,7 @@ from analytics_zoo_tpu.transform.vision.image import (
 )
 from analytics_zoo_tpu.transform.vision.augmentation import (
     AspectScale,
+    AspectScaleCanvas,
     Brightness,
     BytesToMat,
     CenterCrop,

@@ -284,6 +284,43 @@ class AspectScale(FeatureTransformer):
         feature["scale"] = scale
 
 
+class AspectScaleCanvas(FeatureTransformer):
+    """Aspect-preserving resize into one fixed square canvas.
+
+    Reference Faster-RCNN serving uses ``AspectScale(600, max 1000)``
+    (``Resize.scala:73``) which yields a different input shape per image
+    — fine on CPU, one XLA recompile per shape on TPU.  This transform
+    keeps the reference's aspect-preserving geometry (py-faster-rcnn
+    models were trained on undistorted inputs) while holding ONE static
+    shape: scale = canvas/max(h, w), resize, paste top-left into a
+    ``canvas``×``canvas`` field of ``fill``.  Both axes share one scale
+    factor, recorded in ``im_info`` so detections project back to
+    original pixels; the pad region is dead space the conv trunk sees as
+    constant border."""
+
+    def __init__(self, canvas: int, fill: int = 0):
+        super().__init__()
+        self.canvas = canvas
+        self.fill = fill
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        h, w = feature.mat.shape[:2]
+        scale = self.canvas / max(h, w)
+        nh = max(int(round(h * scale)), 1)
+        nw = max(int(round(w * scale)), 1)
+        resized = cv2.resize(feature.mat, (nw, nh))
+        out = np.full((self.canvas, self.canvas) + resized.shape[2:],
+                      self.fill, dtype=resized.dtype)
+        out[:nh, :nw] = resized
+        feature.mat = out
+        feature["scale"] = scale
+        # explicit im_info: the padded mat is canvas-sized, so the
+        # height/width-ratio default would misreport the scales
+        feature["im_info"] = np.array(
+            [nh, nw, nh / max(feature.original_height(), 1),
+             nw / max(feature.original_width(), 1)], np.float32)
+
+
 class RandomAspectScale(AspectScale):
     """AspectScale with min_size drawn from ``scales`` (reference
     ``Resize.scala:118``)."""

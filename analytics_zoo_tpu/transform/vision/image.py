@@ -85,7 +85,11 @@ class ImageFeature:
 
     def get_im_info(self) -> np.ndarray:
         """(height, width, scale_h, scale_w) — reference ``getImInfo``
-        (``image/Types.scala:81``)."""
+        (``image/Types.scala:81``).  A transform that pads the mat (e.g.
+        ``AspectScaleCanvas``) stores an explicit ``im_info`` because the
+        mat-dims-ratio default below would misreport its scales."""
+        if "im_info" in self.state:
+            return np.asarray(self.state["im_info"], np.float32)
         h, w = float(self.height()), float(self.width())
         return np.array([
             h, w,

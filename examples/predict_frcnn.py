@@ -38,6 +38,12 @@ def load_images(image_dir: str, size: int):
             continue
         mats.append(cv2.resize(m, (size, size)).astype(np.float32))
         names.append(os.path.basename(path))
+    if not mats:
+        raise SystemExit(
+            f"predict_frcnn: no decodable images found in {image_dir!r} "
+            "(supported: anything cv2.imread reads, e.g. jpg/png) — "
+            "pass a directory with images or omit --image-dir for the "
+            "random demo batch")
     return np.stack(mats), names
 
 

@@ -297,13 +297,91 @@ def test_uint8_chain_keeps_corrupt_records_aligned():
     ]
     param = PreProcessParam(batch_size=2, resolution=64)
     batches = list(serving_chain(param, uint8=True)(recs))
-    total = sum(b["input"].shape[0] for b in batches)
+    # every batch is the full compiled shape; the final partial batch is
+    # zero-padded and carries the true count in n_valid
+    assert all(b["input"].shape[0] == 2 for b in batches)
+    total = sum(b.get("n_valid", b["input"].shape[0]) for b in batches)
     assert total == 3
     # the corrupt slot is a zero image with default im_info
     assert (batches[0]["input"][1] == 0).all()
     np.testing.assert_allclose(batches[0]["im_info"][1],
                                [64, 64, 1.0, 1.0])
     assert (batches[0]["input"][0] != 0).any()
+
+
+def test_serving_partial_batch_padded_one_shape():
+    """A final partial batch must NOT trigger a new compiled shape: it is
+    padded to batch_size (zero images) and run_serving_loop slices the
+    outputs back to the true record count."""
+    import cv2
+
+    from analytics_zoo_tpu.pipelines.ssd import (
+        run_serving_loop, serving_chain)
+
+    rng = np.random.RandomState(11)
+    img = (rng.rand(32, 32, 3) * 255).astype(np.uint8)
+    ok, buf = cv2.imencode(".jpg", img)
+    assert ok
+    recs = [SSDByteRecord(data=buf.tobytes(), path=f"r{i}")
+            for i in range(5)]                      # 5 records, batch 4
+    param = PreProcessParam(batch_size=4, resolution=32)
+
+    shapes_seen = set()
+
+    def dispatch(batch):
+        shapes_seen.add(batch["input"].shape)
+        return batch["input"].astype(np.float32)    # identity "model"
+
+    out = run_serving_loop(serving_chain(param, uint8=True)(recs),
+                           dispatch, np.asarray)
+    assert len(out) == 5                            # sliced, not 8
+    assert shapes_seen == {(4, 32, 32, 3)}          # ONE compiled shape
+
+
+def test_aspect_scale_canvas_geometry():
+    """AspectScaleCanvas: aspect preserved, one static canvas shape,
+    explicit im_info scales project boxes back to original pixels."""
+    from analytics_zoo_tpu.transform.vision import AspectScaleCanvas, ImageFeature
+
+    f = ImageFeature()
+    f.mat = (np.arange(40 * 80 * 3) % 255).reshape(40, 80, 3).astype(np.uint8)
+    f["original_height"], f["original_width"] = 40, 80
+    AspectScaleCanvas(64).transform(f)
+    assert f.is_valid
+    assert f.mat.shape == (64, 64, 3)
+    info = f.get_im_info()
+    # long side 80 → 64: scale 0.8 on BOTH axes (aspect preserved)
+    np.testing.assert_allclose(info, [32, 64, 0.8, 0.8], atol=1e-6)
+    assert (f.mat[32:] == 0).all()                  # bottom pad
+    assert (f.mat[:32, :] != 0).any()
+
+
+def test_frcnn_predictor_swaps_default_ssd_means():
+    """A user param that only sets batch/resolution must not silently
+    keep the SSD-Caffe means — FrcnnPredictor swaps in the
+    py-faster-rcnn means unless the caller set means explicitly."""
+    import jax
+
+    from analytics_zoo_tpu.models import FasterRcnnDetector, FrcnnParam
+    from analytics_zoo_tpu.pipelines.frcnn import (
+        FRCNN_BGR_MEANS, FrcnnPredictor)
+
+    from analytics_zoo_tpu.ops import ProposalParam
+
+    det = FasterRcnnDetector(param=FrcnnParam(
+        num_classes=3, proposal=ProposalParam(pre_nms_topn=32,
+                                              post_nms_topn=8)))
+    x = jnp.zeros((1, 64, 64, 3))
+    info = jnp.asarray([[64.0, 64.0, 1.0]])
+    variables = det.init(jax.random.PRNGKey(0), x, info)
+
+    p = FrcnnPredictor(det, variables,
+                       PreProcessParam(batch_size=2, resolution=64))
+    assert tuple(p.param.pixel_means) == tuple(FRCNN_BGR_MEANS)
+    custom = FrcnnPredictor(det, variables,
+                            PreProcessParam(resolution=64,
+                                            pixel_means=(1.0, 2.0, 3.0)))
+    assert tuple(custom.param.pixel_means) == (1.0, 2.0, 3.0)
 
 
 def test_uint8_serving_chain_matches_float_chain(tmp_path):
