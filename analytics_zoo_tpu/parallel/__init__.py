@@ -50,12 +50,18 @@ from analytics_zoo_tpu.parallel.expert import (
     route_top1,
 )
 from analytics_zoo_tpu.parallel.pipeline import (
+    flatten_stage_params,
     pipeline_forward,
+    pipeline_forward_het,
+    unflatten_stage,
     split_microbatches,
     stack_stage_params,
 )
 from analytics_zoo_tpu.parallel.tensor import (
     default_tp_rules,
+    megatron_tp_rules,
+    spatial_input_spec,
+    ssd_tp_rules,
     shard_tree,
     sharded_param_count,
 )

@@ -28,7 +28,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from analytics_zoo_tpu.parallel.mesh import MODEL_AXIS
+from analytics_zoo_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 logger = logging.getLogger("analytics_zoo_tpu")
 
@@ -44,12 +44,97 @@ def _last_dim(axis: str):
     return spec
 
 
+def _contract_dim(axis: str):
+    """Shard the CONTRACTION (input-feature) dim — dim 0 of a Dense
+    (in, out) kernel, dim -2 of a Conv (kh, kw, cin, cout) kernel.  The
+    matmul/conv then reduces over a sharded dim: each device contracts
+    its channel slice locally and XLA inserts one all-reduce after
+    (Megatron's "row-parallel" half)."""
+    def spec(shape):
+        axes: List[Optional[str]] = [None] * len(shape)
+        axes[0 if len(shape) <= 2 else len(shape) - 2] = axis
+        return axes
+    return spec
+
+
 def default_tp_rules(axis: str = MODEL_AXIS) -> List[Rule]:
     """Megatron-style column sharding of every learnable matrix's output
     features; biases/scales stay replicated (1-D, tiny)."""
     return [
         (r"(^|.*/)(kernel|embedding)$", _last_dim(axis)),
     ]
+
+
+def megatron_tp_rules(col: Sequence[str], row: Sequence[str],
+                      axis: str = MODEL_AXIS) -> List[Rule]:
+    """Paired column/row rules from two lists of layer names.
+
+    ``col`` layers shard output features (their activations leave
+    channel-sharded); ``row`` layers shard the contraction dim (they
+    consume a channel-sharded OR replicated input with zero gather cost
+    and emit a replicated output after one all-reduce).  Chaining
+    col→row is the Megatron MLP pattern: exactly one collective per
+    pair, never an activation all-gather.  Names match any path
+    component, so ``"conv1_1"`` covers ``params/vgg/conv1_1/kernel`` and
+    its optimizer-slot mirrors."""
+    def name_rule(names: Sequence[str], spec_fn) -> Rule:
+        alt = "|".join(re.escape(n) for n in names)
+        return (rf"(^|.*/)({alt})/(kernel|embedding)$", spec_fn)
+
+    return [name_rule(col, _last_dim(axis)),
+            name_rule(row, _contract_dim(axis))]
+
+
+def ssd_tp_rules(axis: str = MODEL_AXIS) -> List[Rule]:
+    """Tensor-parallel rules tuned to the SSDVgg topology.
+
+    The generic ``default_tp_rules`` col-shards EVERY kernel — but the
+    SSD conf/loc heads have small non-divisible cout (84/126), so their
+    kernels fall back to replicated while their INPUTS arrive
+    channel-sharded from the col-sharded trunk: GSPMD then has no
+    efficient path and emits "Involuntary full rematerialization"
+    (observed on the conf_2 conv in the 8-device dryrun).
+
+    Here every edge is a clean Megatron pair instead: layers whose
+    outputs feed another sharded conv or a detection head are column
+    (cout) sharded; their consumers — including every loc_*/conf_* head,
+    whose contraction dim (512/1024/256) always divides the axis — are
+    row (cin) sharded.  Head outputs come back replicated (one psum),
+    which is exactly what the concat + MultiBoxLoss want."""
+    col = [
+        # one col per VGG block boundary + the head-source producers
+        "conv1_1", "conv2_1", "conv3_1", "conv4_1", "conv4_3",
+        "conv5_2", "fc7",
+        "conv6_2", "conv7_2", "conv8_2", "conv9_2",
+    ]
+    row = [
+        "conv1_2", "conv2_2", "conv3_2", "conv3_3", "conv4_2",
+        "conv5_1", "conv5_3", "fc6",
+        "conv6_1", "conv7_1", "conv8_1", "conv9_1",
+        "loc_0", "loc_1", "loc_2", "loc_3", "loc_4", "loc_5",
+        "conf_0", "conf_1", "conf_2", "conf_3", "conf_4", "conf_5",
+    ]
+    return megatron_tp_rules(col, row, axis)
+
+
+def spatial_input_spec(axis: str = MODEL_AXIS,
+                       data_axis_name: str = DATA_AXIS) -> P:
+    """PartitionSpec for NHWC image batches with the HEIGHT axis sharded
+    over the model axis — *spatial partitioning*, the conv-net tensor
+    parallelism that actually pays on TPU.
+
+    Channel (Megatron) sharding of a VGG-style trunk all-reduces FULL
+    spatial activation maps once per col/row pair — measured 2.1× slower
+    than this mode on the virtual-mesh microbench (TP_MICROBENCH.json).
+    With H sharded and weights replicated, XLA's SPMD partitioner inserts
+    only halo exchanges of kernel_h/2 edge rows per conv (communication
+    O(B·W·C·halo), not O(B·H·W·C)), so each device convolves a horizontal
+    stripe.  Use with ``shard_batch(..., overrides={"input":
+    spatial_input_spec()})`` — parameters stay replicated (no rules).
+    Keep ``ssd_tp_rules``/``megatron_tp_rules`` for models whose FLOPs
+    live in dense/1×1 layers, where the activation all-reduce is small
+    relative to the weight shards gained."""
+    return P(data_axis_name, axis, None, None)
 
 
 def partition_spec(path: str, shape: Tuple[int, ...], mesh: Mesh,
