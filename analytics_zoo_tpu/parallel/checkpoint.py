@@ -23,10 +23,16 @@ def _checkpointer():
 
 def save(path: str, state: Any, step: Optional[int] = None) -> str:
     """Save a pytree (TrainState or raw variables). ``step=None`` overwrites
-    a single 'latest' snapshot (reference ``overWriteCheckpoint``)."""
+    a single 'latest' snapshot (reference ``overWriteCheckpoint``).
+
+    Multi-host: EVERY process must call this (orbax's save has internal
+    cross-process barriers); replicated leaves are read from the local
+    replica so the host conversion itself never blocks on a peer."""
+    from analytics_zoo_tpu.parallel.mesh import host_local_state
+
     name = "latest" if step is None else f"step_{step}"
     target = os.path.join(os.path.abspath(path), name)
-    host_state = jax.device_get(state)
+    host_state = host_local_state(state)
     _checkpointer().save(target, host_state, force=True)
     return target
 

@@ -73,39 +73,103 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(batch, mesh: Mesh):
+def spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh includes devices of OTHER processes — the
+    multi-host regime where arrays must be assembled from per-process
+    local shards (``jax.make_array_from_process_local_data``) instead of
+    ``device_put`` onto devices this process can't address."""
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.flat)
+
+
+def shard_batch(batch, mesh: Mesh, overrides=None):
     """Place a host pytree of arrays onto the mesh, dim-0-sharded over
     ``data`` (the per-iteration device feed of the train loop).
 
     Scalars (0-d leaves) are replicated.  Dim 0 must divide the data-axis
     size — use the data layer's ``drop_remainder``/padded batching for
     ragged tails.
+
+    On a mesh spanning multiple processes, ``batch`` is this process's
+    LOCAL slice of the global batch (each host feeds only the records
+    its ``local_data_slice`` selects — the DistriOptimizer
+    executor-feeds-its-partition contract): the leaves are assembled
+    into global arrays of dim0 = local_dim0 × process_count.
+
+    ``overrides`` maps top-level batch keys to explicit PartitionSpecs —
+    e.g. ``{"input": tensor.spatial_input_spec()}`` shards image HEIGHT
+    over the model axis (spatial-partitioning tensor parallelism).
     """
     axis = data_axis(mesh)
     n_shards = mesh.shape[axis]
+    multiproc = spans_processes(mesh)
 
-    def put(x):
+    def put(x, spec=None):
         x = np.asarray(x)
         if x.ndim == 0:
-            return jax.device_put(x, NamedSharding(mesh, P()))
-        if x.shape[0] % n_shards:
+            sh = NamedSharding(mesh, P())
+            if multiproc:
+                return jax.make_array_from_process_local_data(sh, x)
+            return jax.device_put(x, sh)
+        n_global = x.shape[0] * (jax.process_count() if multiproc else 1)
+        if n_global % n_shards:
             raise ValueError(
-                f"batch dim {x.shape[0]} not divisible by data-axis size "
-                f"{n_shards}; pad the batch or drop the remainder "
+                f"global batch dim {n_global} not divisible by data-axis "
+                f"size {n_shards}; pad the batch or drop the remainder "
                 f"(see data.batching drop_remainder)"
             )
-        return jax.device_put(
-            x, NamedSharding(mesh, P(*([axis] + [None] * (x.ndim - 1))))
-        )
+        if spec is None:
+            spec = P(*([axis] + [None] * (x.ndim - 1)))
+        sh = NamedSharding(mesh, spec)
+        if multiproc:
+            return jax.make_array_from_process_local_data(sh, x)
+        return jax.device_put(x, sh)
 
+    if overrides:
+        return {k: (jax.tree_util.tree_map(
+                        lambda x, k=k: put(x, overrides[k]), v)
+                    if k in overrides
+                    else jax.tree_util.tree_map(put, v))
+                for k, v in batch.items()}
     return jax.tree_util.tree_map(put, batch)
 
 
 def replicate(tree, mesh: Mesh):
     """Replicate a pytree (params/opt state) across the whole mesh — the
     one-time weight distribution that replaces the reference's per-job
-    ``ModelBroadcast`` (``common/Predictor.scala:36``)."""
+    ``ModelBroadcast`` (``common/Predictor.scala:36``).
+
+    Multi-host: every process holds the same host values (deterministic
+    seeded init), so each contributes its local replicas."""
+    if spans_processes(mesh):
+        sh = replicated_sharding(mesh)
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.make_array_from_process_local_data(
+                sh, np.asarray(jax.device_get(leaf))), tree)
     return jax.device_put(tree, replicated_sharding(mesh))
+
+
+def host_local_state(tree):
+    """Host (numpy) copy of a state pytree that may contain multi-process
+    arrays.  ``jax.device_get`` on a non-fully-addressable array can
+    build a cross-process gather program — which deadlocks when only one
+    process runs it (e.g. a checkpoint path).  Replicated leaves instead
+    read their LOCAL replica: no cross-process traffic, any process can
+    call this alone.  Leaves that are genuinely sharded across processes
+    (multi-host tensor parallelism) are not supported here — checkpoint
+    those with a collective-aware saver."""
+    import numpy as _np
+
+    def get(leaf):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            if not leaf.sharding.is_fully_replicated:
+                raise ValueError(
+                    "host_local_state: leaf is sharded across processes; "
+                    "a local read would return one shard, not the value")
+            return _np.asarray(leaf.addressable_data(0))
+        return jax.device_get(leaf)
+
+    return jax.tree_util.tree_map(get, tree)
 
 
 def local_data_slice(global_batch: int, mesh: Mesh) -> Tuple[int, int]:

@@ -123,6 +123,7 @@ def make_train_step(
     compute_dtype=None,
     grad_accum: int = 1,
     device_transform: Optional[Callable] = None,
+    forward_fn: Optional[Callable] = None,
 ):
     """Build the jitted train step.
 
@@ -130,6 +131,13 @@ def make_train_step(
     batch passes through it on-device before the loss (used for the
     device-side augmentation path — halves per-step dispatches and
     avoids materializing the transformed batch in HBM between calls).
+
+    ``forward_fn`` (optional) replaces the default ``module.apply``
+    forward: ``forward_fn(variables, inputs, train, rngs) → (output,
+    new_model_state)``.  Used for parallel-forward variants whose
+    program differs from the plain apply — e.g. the sequence-parallel
+    DS2 forward (``models.deepspeech2.make_sequence_parallel_forward_fn``)
+    that shards T over a ("data", "sequence") mesh inside the step.
 
     ``skip_loss_above`` reproduces MultiBoxLoss's gradient-explosion guard
     (reference ``common/nn/MultiBoxLoss.scala:546``: skip backward when
@@ -163,10 +171,15 @@ def make_train_step(
         else:
             params_c, inputs = params, batch["input"]
         variables = {"params": params_c, **model_state}
-        output, new_model_state = _forward(
-            module, variables, inputs, train=True,
-            rngs={"dropout": rng}, mutable=True,
-        )
+        if forward_fn is not None:
+            output, new_model_state = forward_fn(
+                variables, inputs, train=True, rngs={"dropout": rng})
+            new_model_state = new_model_state or {}
+        else:
+            output, new_model_state = _forward(
+                module, variables, inputs, train=True,
+                rngs={"dropout": rng}, mutable=True,
+            )
         if cdtype is not None:
             output = cast_floating(output, jnp.float32)
             # batch stats remain fp32 masters
@@ -393,7 +406,8 @@ class Optimizer:
                  grad_clip_norm: Optional[float] = None,
                  compute_dtype=None, device_transform=None,
                  param_rules=None, prefetch: int = 0,
-                 grad_accum: int = 1):
+                 grad_accum: int = 1, forward_fn=None,
+                 batch_overrides=None):
         self.model = model
         self.dataset = dataset
         self.criterion = criterion
@@ -423,6 +437,16 @@ class Optimizer:
         self.prefetch = prefetch
         # > 1: accumulate gradients over N microbatches inside the step
         self.grad_accum = grad_accum
+        # custom forward (make_train_step forward_fn hook), e.g. the
+        # sequence-parallel DS2 program
+        self.forward_fn = forward_fn
+        # per-key PartitionSpec overrides for shard_batch, e.g.
+        # {"input": tensor.spatial_input_spec()} for spatial TP
+        self.batch_overrides = batch_overrides
+        if batch_overrides and prefetch:
+            raise ValueError("batch_overrides is not supported with "
+                             "prefetch (the prefetch path shards with "
+                             "the default data-axis specs)")
         self._score_name: Optional[str] = None
         self.resume_path: Optional[str] = None
         self._resume_requested = False
@@ -498,6 +522,7 @@ class Optimizer:
             compute_dtype=self.compute_dtype,
             grad_accum=self.grad_accum,
             device_transform=self.device_transform,
+            forward_fn=self.forward_fn,
         )
         eval_step = make_eval_step(self.model.module,
                                    compute_dtype=self.compute_dtype)
@@ -524,7 +549,9 @@ class Optimizer:
                 for batch in epoch_batches:
                     n = _batch_size(batch)
                     dev_batch = (batch if self.prefetch
-                                 else mesh_lib.shard_batch(batch, self.mesh))
+                                 else mesh_lib.shard_batch(
+                                     batch, self.mesh,
+                                     overrides=self.batch_overrides))
                     # device_transform is fused INSIDE train_step
                     state, metrics = train_step(state, dev_batch,
                                                 self.optim.lr_scale)
@@ -568,8 +595,9 @@ class Optimizer:
             t_epoch, records = time.time(), 0
             self._maybe_validate(loop, state, eval_step)
             self._maybe_checkpoint(loop, state)
-        # write trained variables back into the model wrapper
-        host_state = jax.device_get(state)
+        # write trained variables back into the model wrapper (local-
+        # replica read: safe on a mesh spanning processes)
+        host_state = mesh_lib.host_local_state(state)
         self.model.variables = state_to_variables(host_state)
         self._last_state = host_state
         return self.model
@@ -614,12 +642,19 @@ class Optimizer:
 
         from analytics_zoo_tpu.parallel import checkpoint as ckpt
         tag = None if self.overwrite_checkpoint else loop.iteration
+        # multi-host: EVERY process calls save (orbax has internal
+        # cross-process barriers and elects the writer itself); the
+        # trigger decision above is deterministic and replicated, so all
+        # processes reach this point together
         ckpt.save(self.checkpoint_path, state, step=tag)
+        if jax.process_index() != 0:
+            return
         # loop-position + host-optim sidecar so resume restores
         # epoch/iteration/in-epoch position and Plateau's learned LR state
         # (the TrainState only carries the step counter).  Written via
         # temp-file + rename so a crash between the orbax save and this
-        # write can't pair new params with stale metadata.
+        # write can't pair new params with stale metadata.  One writer:
+        # process 0 (plain host I/O, no collective to stay in step with).
         meta = {"epoch": loop.epoch, "iteration": loop.iteration,
                 "iter_in_epoch": self._iter_in_epoch,
                 "optim": self.optim.state_dict()}
