@@ -31,6 +31,7 @@ from typing import Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from analytics_zoo_tpu.core.rnn import BiRecurrent, Recurrent, RnnCell
 
@@ -113,7 +114,8 @@ class DeepSpeech2(nn.Module):
 def sequence_parallel_forward(variables, x, mesh,
                               axis_name: str = "sequence",
                               batch_axis: str = None,
-                              model: "DeepSpeech2" = None):
+                              model: "DeepSpeech2" = None,
+                              train: bool = False):
     """DS2 inference forward with the TIME axis sharded across devices —
     the SURVEY.md §5 north-star capability ("shard T across devices for
     DS2 BiRNN"); the reference's only long-audio mechanism is lossy
@@ -135,6 +137,18 @@ def sequence_parallel_forward(variables, x, mesh,
     Memory per device is O(T/n), so utterances far beyond single-chip HBM
     stream through; wall-clock of the recurrence itself stays sequential
     (inherent to RNNs — attention models get ring_attention instead).
+
+    ``train=True`` switches every SequenceBN to BATCH statistics computed
+    over the GLOBAL (B, T) — local sums psum'd over the batch and
+    sequence mesh axes, exactly flax ``BatchNorm(use_running_average=
+    False)`` semantics on the unsharded input — and the return value
+    becomes ``(log_probs, {"batch_stats": updated_running_stats})`` (the
+    EMA update a mutable flax apply would produce).  This makes the
+    whole forward differentiable end-to-end on the 2D mesh: grads flow
+    through the halo exchange, the psum'd BN stats, and the pipelined
+    bidirectional chunk scans (all ppermute-based, all with defined
+    transposes; the fori_loop round counts are static so reverse-mode AD
+    lowers them to scans).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -142,14 +156,12 @@ def sequence_parallel_forward(variables, x, mesh,
         _shard_map, halo_exchange, sequence_scan_local_bidir)
 
     model = model or DeepSpeech2()
-    params = variables["params"]
-    stats = variables.get("batch_stats", {})
     eps = 1e-5
+    momentum = 0.9                       # SequenceBN default
 
-    def bn(name, h):
-        p, s = params[name]["BatchNorm_0"], stats[name]["BatchNorm_0"]
-        inv = p["scale"] / jnp.sqrt(s["var"] + eps)
-        return (h - s["mean"]) * inv + p["bias"]
+    psum_axes = tuple(a for a in (batch_axis, axis_name) if a)
+    n_global = int(np.prod([mesh.shape[a] for a in psum_axes])) \
+        if psum_axes else 1
 
     def rnn_step(kernel, bias):
         def step(h, x_t):
@@ -163,7 +175,35 @@ def sequence_parallel_forward(variables, x, mesh,
             f"T={x.shape[1]} must be divisible by 2·n_seq={2 * n_seq} "
             "(even per-device chunks for the stride-2 conv front-end)")
 
-    def local(x_l):
+    # params/stats enter shard_map as EXPLICIT replicated arguments, not
+    # closure captures: a capture would carry the enclosing jit's (Auto-
+    # mesh) shardings into the Manual context, which the transpose of the
+    # capture rejects when this forward runs under grad inside a jitted
+    # train step ("Context mesh ... should match the mesh of sharding").
+    def local(params, stats, x_l):
+        new_stats = {}
+
+        def bn(name, h):
+            p, s = params[name]["BatchNorm_0"], stats[name]["BatchNorm_0"]
+            if train:
+                # global batch statistics: psum local sums over the mesh
+                s1 = jnp.sum(h, axis=(0, 1))
+                s2 = jnp.sum(h * h, axis=(0, 1))
+                for a in psum_axes:
+                    s1 = jax.lax.psum(s1, a)
+                    s2 = jax.lax.psum(s2, a)
+                cnt = h.shape[0] * h.shape[1] * n_global
+                mean = s1 / cnt
+                var = s2 / cnt - mean * mean     # biased, like flax
+                new_stats[name] = {"BatchNorm_0": {
+                    "mean": momentum * s["mean"] + (1 - momentum) * mean,
+                    "var": momentum * s["var"] + (1 - momentum) * var,
+                }}
+            else:
+                mean, var = s["mean"], s["var"]
+            inv = p["scale"] / jnp.sqrt(var + eps)
+            return (h - mean) * inv + p["bias"]
+
         B, Tb, F = x_l.shape
         h = x_l[..., None]
         # conv1: kernel 11 pad 5 stride 2 → halo 5 each side, VALID conv
@@ -189,13 +229,54 @@ def sequence_parallel_forward(variables, x, mesh,
             h = fwd + bwd
         h = bn("bn_out", h)
         logits = h @ params["fc_out"]["kernel"] + params["fc_out"]["bias"]
-        return jax.nn.log_softmax(logits, axis=-1)
+        out = jax.nn.log_softmax(logits, axis=-1)
+        if train:
+            return out, new_stats
+        return out
 
+    params = variables["params"]
+    stats = variables.get("batch_stats", {})
     spec = P(batch_axis, axis_name, None)
-    fn = _shard_map(local, mesh, in_specs=(spec,), out_specs=spec)
+    rep = P()                            # replicated weights/stats
+    p_specs = jax.tree_util.tree_map(lambda _: rep, params)
+    s_specs = jax.tree_util.tree_map(lambda _: rep, stats)
+    if train:
+        # psum'd stats are identical on every device: replicated outputs
+        stats_specs = {
+            name: {"BatchNorm_0": {"mean": P(), "var": P()}}
+            for name in ["bn_conv1", "bn_out"]
+            + [f"bn_rnn{i}" for i in range(model.n_rnn_layers)]}
+        fn = _shard_map(local, mesh, in_specs=(p_specs, s_specs, spec),
+                        out_specs=(spec, stats_specs))
+    else:
+        fn = _shard_map(local, mesh, in_specs=(p_specs, s_specs, spec),
+                        out_specs=spec)
     sharding = NamedSharding(mesh, spec)
     if isinstance(x, jax.core.Tracer):   # under jit: constrain, don't put
         x = jax.lax.with_sharding_constraint(x, sharding)
     else:
         x = jax.device_put(x, sharding)
-    return fn(x)
+    return fn(params, stats, x)
+
+
+def make_sequence_parallel_forward_fn(model: "DeepSpeech2", mesh,
+                                      axis_name: str = "sequence",
+                                      batch_axis: str = "data"):
+    """``forward_fn`` for ``make_train_step`` / ``Optimizer``: the DS2
+    forward with T sharded over ``axis_name`` — sequence-parallel CTC
+    *training* on a ("data", "sequence") mesh (SURVEY.md §5 north star,
+    closed for training; round-2 only had inference).  The returned
+    callable matches the hook contract: ``(variables, inputs, train,
+    rngs) → (log_probs, new_model_state)``."""
+
+    def forward_fn(variables, inputs, train=False, rngs=None):
+        out = sequence_parallel_forward(variables, inputs, mesh,
+                                        axis_name=axis_name,
+                                        batch_axis=batch_axis,
+                                        model=model, train=train)
+        if train:
+            logp, new_stats = out
+            return logp, {"batch_stats": new_stats}
+        return out, None
+
+    return forward_fn

@@ -290,12 +290,21 @@ def make_ds2_model(hidden: int = 1024, n_rnn_layers: int = 3,
 
 def train_ds2(model: Model, dataset, epochs: int = 10, lr: float = 3e-4,
               mesh=None, checkpoint_path: Optional[str] = None,
-              param_rules=None):
+              param_rules=None, sequence_parallel: bool = False):
     """CTC training for DS2 — capability the reference lacks (its DS2 is
     inference-only; SURVEY.md §2.3).  ``dataset`` yields batches
     ``{"input": (B,T,n_mels), "labels": (B,L) int32, "label_mask": (B,L)}``.
     ``param_rules`` enables tensor-parallel weight sharding
     (``parallel.tensor.default_tp_rules``) on a data×model mesh.
+
+    ``sequence_parallel=True`` (mesh must carry a "sequence" axis, e.g.
+    ``create_mesh((2, 4), axis_names=("data", "sequence"))``) trains with
+    the TIME axis sharded: the step's forward is the pipelined-scan +
+    halo-exchange program of ``models.deepspeech2.sequence_parallel_forward``
+    with global-batch BN statistics, so activation memory per device is
+    O(T/n) — long-audio CTC training beyond single-chip HBM.  The CTC
+    loss itself consumes the (tiny, n_alphabet-wide) log-probs gathered
+    back over T.
     """
     from analytics_zoo_tpu.core.criterion import CTCCriterion
     from analytics_zoo_tpu.parallel import Adam, Optimizer, Trigger, create_mesh
@@ -307,8 +316,19 @@ def train_ds2(model: Model, dataset, epochs: int = 10, lr: float = 3e-4,
         return ctc(log_probs, batch["labels"],
                    label_mask=batch.get("label_mask"))
 
+    forward_fn = None
+    if sequence_parallel:
+        from analytics_zoo_tpu.models.deepspeech2 import (
+            make_sequence_parallel_forward_fn)
+        if "sequence" not in mesh.axis_names:
+            raise ValueError("sequence_parallel=True needs a mesh with a "
+                             f"'sequence' axis, got {mesh.axis_names}")
+        forward_fn = make_sequence_parallel_forward_fn(
+            model.module, mesh,
+            batch_axis="data" if "data" in mesh.axis_names else None)
+
     opt = (Optimizer(model, dataset, criterion, mesh=mesh,
-                     param_rules=param_rules)
+                     param_rules=param_rules, forward_fn=forward_fn)
            .set_optim_method(Adam(lr))
            .set_end_when(Trigger.max_epoch(epochs)))
     if checkpoint_path:
