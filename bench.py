@@ -405,7 +405,9 @@ def main() -> int:
         args.workers = 4
     skip = set(s for s in args.skip.split(",") if s)
 
-    ALL_PHASES = ["ssd_train_hostaug", "ssd_serve", "nms", "ds2",
+    # cheap phases first so a flaky relay still leaves recorded metrics;
+    # ssd_train stays last (the driver reads the LAST line as headline)
+    ALL_PHASES = ["nms", "ds2", "ssd_serve", "ssd_train_hostaug",
                   "ssd_train"]
     if not args.child and not args.no_isolate:
         # One SUBPROCESS per phase: the tunneled-TPU relay degrades
@@ -440,7 +442,11 @@ def main() -> int:
             # and poison every later phase
             proc = subprocess.Popen(cmd, start_new_session=True)
             try:
-                rc = rc or proc.wait(timeout=limit)
+                # NOTE: always wait — `rc or proc.wait()` would short-
+                # circuit after the first failed phase and burst-launch
+                # every remaining phase CONCURRENTLY (observed: 4 phases
+                # contending for the one chip, all numbers garbage)
+                phase_rc = proc.wait(timeout=limit)
             except subprocess.TimeoutExpired:
                 import signal
 
@@ -449,7 +455,13 @@ def main() -> int:
                 _emit(f"{phase}_error", 0.0, "none", None,
                       error=f"phase exceeded {limit}s "
                             "(TPU relay hang?) — killed")
-                rc = rc or 1
+                phase_rc = 1
+            if phase_rc:
+                # a silent nonzero exit (e.g. OOM SIGKILL) must leave a
+                # visible record, not just an empty output
+                _emit(f"{phase}_exit", float(phase_rc), "returncode", None,
+                      error=f"phase child exited rc={phase_rc}")
+            rc = rc or phase_rc
         return rc
 
     from analytics_zoo_tpu.data import generate_shapes_records, read_ssd_records
