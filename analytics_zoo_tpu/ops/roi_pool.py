@@ -44,29 +44,32 @@ def roi_pool(feat: jax.Array, rois: jax.Array,
     def _round_c(x):
         return jnp.trunc(x + jnp.sign(x) * 0.5)
 
-    start_w = _round_c(rois[:, 0] * spatial_scale)
-    start_h = _round_c(rois[:, 1] * spatial_scale)
-    end_w = _round_c(rois[:, 2] * spatial_scale)
-    end_h = _round_c(rois[:, 3] * spatial_scale)
-    roi_w = jnp.maximum(end_w - start_w + 1.0, 1.0)        # (R,)
-    roi_h = jnp.maximum(end_h - start_h + 1.0, 1.0)
-    bin_w = roi_w / pooled_w
-    bin_h = roi_h / pooled_h
+    start_w = _round_c(rois[:, 0] * spatial_scale).astype(jnp.int32)
+    start_h = _round_c(rois[:, 1] * spatial_scale).astype(jnp.int32)
+    end_w = _round_c(rois[:, 2] * spatial_scale).astype(jnp.int32)
+    end_h = _round_c(rois[:, 3] * spatial_scale).astype(jnp.int32)
+    roi_w = jnp.maximum(end_w - start_w + 1, 1)            # (R,) int32
+    roi_h = jnp.maximum(end_h - start_h + 1, 1)
 
-    ph = jnp.arange(pooled_h, dtype=jnp.float32)
-    pw = jnp.arange(pooled_w, dtype=jnp.float32)
-    # (R, PH) / (R, PW) integer bin bounds, clipped to the feature map
-    hstart = jnp.clip(jnp.floor(ph[None] * bin_h[:, None])
+    # Bin bounds in exact INTEGER arithmetic: floor(k·rh/P) = (k·rh)//P
+    # and ceil(k·rh/P) = (k·rh + P - 1)//P.  A float formulation is not
+    # backend-deterministic — XLA lowers x/P to x·(1/P), whose rounding
+    # can cross an integer right where ceil() sits (observed: rh=3, P=7,
+    # bin 6 picked up one extra row vs the Caffe C++ loop).  Integer
+    # bounds equal the infinite-precision semantics everywhere.
+    ph = jnp.arange(pooled_h, dtype=jnp.int32)
+    pw = jnp.arange(pooled_w, dtype=jnp.int32)
+    hstart = jnp.clip((ph[None] * roi_h[:, None]) // pooled_h
                       + start_h[:, None], 0, H)
-    hend = jnp.clip(jnp.ceil((ph[None] + 1) * bin_h[:, None])
-                    + start_h[:, None], 0, H)
-    wstart = jnp.clip(jnp.floor(pw[None] * bin_w[:, None])
+    hend = jnp.clip(((ph[None] + 1) * roi_h[:, None] + pooled_h - 1)
+                    // pooled_h + start_h[:, None], 0, H)
+    wstart = jnp.clip((pw[None] * roi_w[:, None]) // pooled_w
                       + start_w[:, None], 0, W)
-    wend = jnp.clip(jnp.ceil((pw[None] + 1) * bin_w[:, None])
-                    + start_w[:, None], 0, W)
+    wend = jnp.clip(((pw[None] + 1) * roi_w[:, None] + pooled_w - 1)
+                    // pooled_w + start_w[:, None], 0, W)
 
-    hidx = jnp.arange(H, dtype=jnp.float32)
-    widx = jnp.arange(W, dtype=jnp.float32)
+    hidx = jnp.arange(H, dtype=jnp.int32)
+    widx = jnp.arange(W, dtype=jnp.int32)
 
     def one_roi(hs, he, ws, we):
         mask_h = (hidx[None, :] >= hs[:, None]) & (hidx[None, :] < he[:, None])
