@@ -246,6 +246,9 @@ class AttentionASR(nn.Module):
     n_mels: int = 13
     conv_channels: int = 32
     attention_fn: Callable = full_attention
+    n_experts: int = 0                  # > 0 → MoE feed-forward blocks
+    expert_mesh: Optional[object] = None
+    capacity_factor: float = 1.25
 
     def setup(self):
         self.conv1 = nn.Conv(self.conv_channels, (11, self.n_mels),
@@ -254,6 +257,9 @@ class AttentionASR(nn.Module):
         self.encoder = LongContextEncoder(dim=self.dim, depth=self.depth,
                                           num_heads=self.num_heads,
                                           attention_fn=self.attention_fn,
+                                          n_experts=self.n_experts,
+                                          expert_mesh=self.expert_mesh,
+                                          capacity_factor=self.capacity_factor,
                                           name="encoder")
         self.fc_out = nn.Dense(self.n_alphabet, name="fc_out")
 

@@ -23,6 +23,7 @@ from analytics_zoo_tpu.transform.audio.decoders import (
     VocabDecoder,
     beam_search_decode,
     best_path_decode,
+    evaluate_ctc_decoders,
     cer,
     levenshtein,
     wer,

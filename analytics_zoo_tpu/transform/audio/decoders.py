@@ -230,3 +230,39 @@ class ASREvaluator:
     @property
     def cer(self) -> float:
         return self.char_errors / max(self.chars, 1)
+
+
+def evaluate_ctc_decoders(forward_fn, batches,
+                          alphabet: str = ALPHABET) -> dict:
+    """Held-out CER / exact-sequence accuracy with BOTH the greedy and
+    prefix-beam decoders — the shared evaluation block of
+    ``examples/train_ds2.py`` and ``examples/train_attention_asr.py``
+    (one implementation so the two reports can never drift).
+
+    ``forward_fn(inputs) → (B, T, n_alphabet) log-probs``; ``batches``
+    yield ``{"input", "labels"}`` with 0 = padding in labels.
+    """
+    import numpy as np
+
+    stats = {"greedy": [0, 0], "beam": [0, 0]}    # [edit distance, exact]
+    total_len = n_seq = 0
+    for hb in batches:
+        log_probs = forward_fn(hb["input"])
+        for i in range(hb["input"].shape[0]):
+            ref = "".join(alphabet[t] for t in hb["labels"][i] if t > 0)
+            lp = np.asarray(log_probs[i])
+            for name, hyp in (("greedy", best_path_decode(lp, alphabet)),
+                              ("beam", beam_search_decode(lp,
+                                                          alphabet=alphabet))):
+                stats[name][0] += levenshtein(hyp, ref)
+                stats[name][1] += int(hyp == ref)
+            total_len += max(len(ref), 1)
+            n_seq += 1
+    g, b = stats["greedy"], stats["beam"]
+    return {
+        "cer": round(g[0] / max(total_len, 1), 4),
+        "exact_sequence_acc": round(g[1] / max(n_seq, 1), 4),
+        "beam_cer": round(b[0] / max(total_len, 1), 4),
+        "beam_exact_sequence_acc": round(b[1] / max(n_seq, 1), 4),
+        "sequences": n_seq,
+    }

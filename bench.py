@@ -437,30 +437,52 @@ def main() -> int:
             cmd = [sys.executable, os.path.abspath(__file__), "--child",
                    "--skip", child_skip] + passthrough
             limit = args.phase_timeout if args.phase_timeout > 0 else None
-            # new session so a timeout can kill the WHOLE group — a hung
-            # relay/worker grandchild would otherwise survive the child
-            # and poison every later phase
-            proc = subprocess.Popen(cmd, start_new_session=True)
-            try:
-                # NOTE: always wait — `rc or proc.wait()` would short-
-                # circuit after the first failed phase and burst-launch
-                # every remaining phase CONCURRENTLY (observed: 4 phases
-                # contending for the one chip, all numbers garbage)
-                phase_rc = proc.wait(timeout=limit)
-            except subprocess.TimeoutExpired:
-                import signal
+            for attempt in range(3):
+                t_phase = time.time()
+                # new session so a timeout can kill the WHOLE group — a
+                # hung relay/worker grandchild would otherwise survive
+                # the child and poison every later phase
+                proc = subprocess.Popen(cmd, start_new_session=True)
+                try:
+                    # NOTE: always wait — `rc or proc.wait()` would
+                    # short-circuit after the first failed phase and
+                    # burst-launch every remaining phase CONCURRENTLY
+                    # (observed: 4 phases contending for the one chip,
+                    # all numbers garbage)
+                    phase_rc = proc.wait(timeout=limit)
+                except subprocess.TimeoutExpired:
+                    import signal
 
-                os.killpg(proc.pid, signal.SIGKILL)
-                proc.wait()
-                _emit(f"{phase}_error", 0.0, "none", None,
-                      error=f"phase exceeded {limit}s "
-                            "(TPU relay hang?) — killed")
-                phase_rc = 1
-            if phase_rc:
-                # a silent nonzero exit (e.g. OOM SIGKILL) must leave a
-                # visible record, not just an empty output
+                    os.killpg(proc.pid, signal.SIGKILL)
+                    proc.wait()
+                    _emit(f"{phase}_error", 0.0, "none", None,
+                          error=f"phase exceeded {limit}s "
+                                "(TPU relay hang?) — killed")
+                    phase_rc = 1
+                    break               # a 40-min hang is not retryable
+                if phase_rc == 0:
+                    break
+                # a silent nonzero exit must leave a visible record; a
+                # QUICK failure is usually the relay refusing the
+                # backend ("TPU backend setup error (Unavailable)") —
+                # worth retrying after a pause, unlike a long run that
+                # died mid-measurement
+                quick = (time.time() - t_phase) < 600
+                retrying = quick and attempt < 2
+                # NOTE ordering contract for consumers: a retried child
+                # may have emitted partial metric lines before dying;
+                # this exit record separates them from the retry's fresh
+                # lines, and later lines supersede earlier ones with the
+                # same metric name (the headline is always the LAST line)
                 _emit(f"{phase}_exit", float(phase_rc), "returncode", None,
-                      error=f"phase child exited rc={phase_rc}")
+                      attempt=attempt,
+                      error=f"phase child exited rc={phase_rc}"
+                            + ("; retrying (relay unavailable?) — lines "
+                               "above from this phase are superseded"
+                               if retrying else ""))
+                if not retrying:
+                    break
+                time.sleep(90)
             rc = rc or phase_rc
         return rc
 

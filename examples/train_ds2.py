@@ -106,39 +106,23 @@ def main():
 
     # held-out eval: decode unseen synthetic utterances with BOTH the
     # greedy and prefix-beam decoders, score token-level edit distance
-    # (the ASREvaluator CER machinery)
+    # (the shared evaluate_ctc_decoders harness)
     import json
 
     import jax
 
-    from analytics_zoo_tpu.transform.audio import (beam_search_decode,
-                                                   best_path_decode)
-    from analytics_zoo_tpu.transform.audio.decoders import levenshtein
+    from analytics_zoo_tpu.transform.audio import evaluate_ctc_decoders
 
-    stats = {"greedy": [0, 0], "beam": [0, 0]}   # edit distance, exact
-    total_len = n_seq = 0
-    for hb in heldout:
-        log_probs = model.forward(hb["input"])
-        for i in range(hb["input"].shape[0]):
-            ref = "".join(ALPHABET[t] for t in hb["labels"][i]
-                          if t > 0)
-            lp = np.asarray(log_probs[i])
-            for name, hyp in (("greedy", best_path_decode(lp)),
-                              ("beam", beam_search_decode(lp))):
-                stats[name][0] += levenshtein(hyp, ref)
-                stats[name][1] += int(hyp == ref)
-            total_len += max(len(ref), 1)
-            n_seq += 1
+    m = evaluate_ctc_decoders(model.forward, heldout)
     cer_field = ("train_set_cer" if heldout_is_train else "cer")
-    g, b = stats["greedy"], stats["beam"]
     report = {
         "task": ("LibriSpeech-style dir" if args.data_dir
                  else "synthetic tone→token CTC (held-out)"),
-        cer_field: round(g[0] / max(total_len, 1), 4),
-        "exact_sequence_acc": round(g[1] / max(n_seq, 1), 4),
-        "beam_" + cer_field: round(b[0] / max(total_len, 1), 4),
-        "beam_exact_sequence_acc": round(b[1] / max(n_seq, 1), 4),
-        "sequences": n_seq,
+        cer_field: m["cer"],
+        "exact_sequence_acc": m["exact_sequence_acc"],
+        "beam_" + cer_field: m["beam_cer"],
+        "beam_exact_sequence_acc": m["beam_exact_sequence_acc"],
+        "sequences": m["sequences"],
         "epochs": args.epochs,
         "backend": jax.default_backend(),
     }
