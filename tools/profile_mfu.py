@@ -1,0 +1,207 @@
+"""MFU breakdown + batch sweep for the bf16 SSD300 train step (VERDICT
+round-2 item 7: name the time sinks, push past 0.463, or commit a
+profile-backed analysis of why SSD-VGG caps below 0.5).
+
+Method (works on the tunneled chip where trace viewers aren't
+available): build four compiled programs of increasing scope —
+
+  fwd            model forward only
+  fwd_loss       forward + MultiBoxLoss
+  grads          forward + backward (no update)
+  step           the full train step (fwd+bwd+SGD update)
+
+time each with readback-fenced windows on the SAME device-resident
+batch, and report each stage's incremental cost plus MFU from XLA's
+compiled FLOP count.  Then sweep batch size at fixed resolution — the
+usual single-chip MFU lever (bigger batch = better MXU tiling and less
+per-dispatch overhead per image).
+
+Writes one JSON to --out (default MFU_PROFILE.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def timed(fn, *args, iters=10):
+    import jax
+
+    out = fn(*args)                  # compile
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    # SCALAR readback fence: block_until_ready under-waits on the relay,
+    # and reading a whole output tensor would put the transfer inside
+    # the timed window — slice to one element ON DEVICE first
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(leaf.ravel()[0])
+    return (time.perf_counter() - t0) / iters
+
+
+def flops_of(jitted, *args):
+    """FLOPs from an ALREADY-JITTED fn's compiled cost analysis (reuses
+    the jit cache — wrapping in a fresh jit would force a recompile)."""
+    try:
+        ca = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca.get("flops", 0.0))
+    except Exception:
+        return 0.0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batches", type=int, nargs="+", default=[32, 48, 64])
+    p.add_argument("--res", type=int, default=300)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--out", default="MFU_PROFILE.json")
+    args = p.parse_args()
+
+    global jax
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.models import SSDVgg, build_priors
+    from analytics_zoo_tpu.ops import MultiBoxLoss, MultiBoxLossParam
+    from analytics_zoo_tpu.parallel import (SGD, create_mesh,
+                                            create_train_state,
+                                            make_train_step, replicate,
+                                            shard_batch)
+    from analytics_zoo_tpu.parallel.train import cast_floating
+
+    kind = jax.devices()[0].device_kind
+    peak = {"TPU v5 lite": 197.0, "TPU v5e": 197.0, "TPU v4": 275.0,
+            "TPU v5p": 459.0, "TPU v6 lite": 918.0}.get(kind)
+    mesh = create_mesh()
+    model = Model(SSDVgg(num_classes=21, resolution=args.res))
+    model.build(0, jnp.zeros((1, args.res, args.res, 3), jnp.float32))
+    priors, variances = build_priors(model.module.config)
+    criterion = MultiBoxLoss(priors, variances, MultiBoxLossParam())
+    optim = SGD(1e-3, momentum=0.9)
+
+    report = {"device_kind": kind, "peak_bf16_tflops": peak,
+              "resolution": args.res, "stages": {}, "batch_sweep": []}
+
+    def make_batch(b):
+        rng = np.random.RandomState(0)
+        return shard_batch({
+            "input": rng.rand(b, args.res, args.res, 3).astype(np.float32),
+            "target": {
+                "bboxes": np.tile(np.asarray([0.1, 0.1, 0.6, 0.6],
+                                             np.float32), (b, 4, 1)),
+                "labels": np.ones((b, 4), np.int32),
+                "mask": np.ones((b, 4), np.float32),
+            },
+        }, mesh)
+
+    # one host snapshot of the initial state: the train step DONATES its
+    # state buffers, and model.variables aliases them — later rebuilds
+    # would hand deleted arrays to device_put
+    host_state0 = jax.device_get(create_train_state(model, optim))
+
+    # ---- stage breakdown at the first batch size ----
+    B = args.batches[0]
+    batch = make_batch(B)
+    state = replicate(host_state0, mesh)
+    params_bf16 = cast_floating(state.params, jnp.bfloat16)
+    # device-side cast KEEPS the batch sharding (a host round-trip would
+    # hand the stage fns a replicated batch while the full step runs the
+    # sharded one — incomparable timings on a multi-device mesh)
+    x_bf16 = batch["input"].astype(jnp.bfloat16)
+
+    def fwd(p, x):
+        return model.module.apply({"params": p}, x, train=True,
+                                  rngs={"dropout": jax.random.PRNGKey(0)},
+                                  mutable=["batch_stats"])[0]
+
+    def loss_only(p, x, tgt):
+        out = fwd(p, x)
+        out = jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), out)
+        return criterion(out, tgt)
+
+    def grads(p, x, tgt):
+        return jax.grad(loss_only)(p, x, tgt)
+
+    tgt = batch["target"]
+    jf = jax.jit(fwd)
+    jl = jax.jit(loss_only)
+    jg = jax.jit(grads)
+    step = make_train_step(model.module, criterion, optim, mesh=mesh,
+                           compute_dtype="bf16")
+
+    t_fwd = timed(jf, params_bf16, x_bf16, iters=args.iters)
+    t_loss = timed(jl, params_bf16, x_bf16, tgt, iters=args.iters)
+    t_grad = timed(jg, params_bf16, x_bf16, tgt, iters=args.iters)
+
+    st = replicate(host_state0, mesh)
+    st, m = step(st, batch, 1.0)
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        st, m = step(st, batch, 1.0)
+    float(np.asarray(m["loss"]))
+    t_step = (time.perf_counter() - t0) / args.iters
+
+    f_step = flops_of(step, st, batch, 1.0)
+    f_fwd = flops_of(jf, params_bf16, x_bf16)
+    f_grad = flops_of(jg, params_bf16, x_bf16, tgt)
+    tf_step = f_step / t_step / 1e12 if f_step else None
+    report["stages"] = {
+        "batch": B,
+        "fwd_ms": round(t_fwd * 1e3, 2),
+        "fwd_plus_loss_ms": round(t_loss * 1e3, 2),
+        "fwd_bwd_ms": round(t_grad * 1e3, 2),
+        "full_step_ms": round(t_step * 1e3, 2),
+        "loss_increment_ms": round((t_loss - t_fwd) * 1e3, 2),
+        "bwd_increment_ms": round((t_grad - t_loss) * 1e3, 2),
+        "update_increment_ms": round((t_step - t_grad) * 1e3, 2),
+        "fwd_gflops": round(f_fwd / 1e9, 1) if f_fwd else None,
+        "fwd_bwd_gflops": round(f_grad / 1e9, 1) if f_grad else None,
+        "step_gflops": round(f_step / 1e9, 1) if f_step else None,
+        "step_tflops_per_sec": round(tf_step, 2) if tf_step else None,
+        "step_mfu": (round(tf_step / peak, 4)
+                     if (tf_step and peak) else None),
+    }
+
+    # ---- batch sweep on the full step ----
+    # ONE jitted step serves every batch size (its cache is keyed on
+    # shapes, so only genuinely-new shapes compile; rebuilding the step
+    # per size would recompile even the shape the stage section used)
+    for b in args.batches:
+        bt = make_batch(b)
+        st = replicate(host_state0, mesh)
+        st, m = step(st, bt, 1.0)
+        jax.block_until_ready(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            st, m = step(st, bt, 1.0)
+        float(np.asarray(m["loss"]))
+        dt = (time.perf_counter() - t0) / args.iters
+        fl = flops_of(step, st, bt, 1.0)
+        tflops = fl / dt / 1e12 if fl else None
+        report["batch_sweep"].append({
+            "batch": b,
+            "step_ms": round(dt * 1e3, 2),
+            "images_per_sec": round(b / dt, 1),
+            "model_tflops": round(tflops, 2) if tflops else None,
+            "mfu": round(tflops / peak, 4) if (tflops and peak) else None,
+        })
+        print(json.dumps(report["batch_sweep"][-1]), flush=True)
+
+    print(json.dumps(report))
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
