@@ -52,7 +52,8 @@ class FrcnnPredictor:
     def __init__(self, detector: FasterRcnnDetector, variables,
                  param: Optional[PreProcessParam] = None,
                  aspect_preserving: bool = True,
-                 swap_default_means: bool = True):
+                 swap_default_means: bool = True,
+                 quantize: bool = False):
         self.detector = detector
         self.variables = variables
         if param is None:
@@ -74,14 +75,24 @@ class FrcnnPredictor:
         self.aspect_preserving = aspect_preserving
         means = np.asarray(self.param.pixel_means, np.float32)
 
-        def fwd(v, x, info):
+        def apply_fn(v, x, info):
             if x.dtype == jnp.uint8:
                 # uint8 staging path: normalize on device (4× fewer
                 # host→device bytes than float32 staging)
                 x = x.astype(jnp.float32) - means
             return detector.apply(v, x, info)
 
-        self._fwd = jax.jit(fwd)
+        if quantize:
+            # int8 weight-only serving, like SSDPredictor(quantize=True):
+            # weights live int8 in HBM (~4× smaller), dequant is fused
+            # into the consuming convs/matmuls inside the jitted program
+            from analytics_zoo_tpu.utils.quantize import (
+                make_quantized_forward, quantize_params)
+
+            self.variables = quantize_params(variables)
+            self._fwd = make_quantized_forward(detector, apply_fn=apply_fn)
+        else:
+            self._fwd = jax.jit(apply_fn)
 
     def _detect_device(self, batch: Dict):
         """Dispatch one batch (async); returns (device detections,

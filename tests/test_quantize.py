@@ -133,6 +133,53 @@ class TestQuantizedPredictor:
             assert b.shape == q.shape
             np.testing.assert_allclose(q[:, 1], b[:, 1], atol=0.05)
 
+    def test_frcnn_predictor_quantized_matches_dequantized_fp32(self):
+        """FrcnnPredictor(quantize=True)'s serving-path contract: the
+        int8-in-HBM program equals the fp32 program run on the SAME
+        dequantized weights.  (Closeness to the ORIGINAL fp32 weights is
+        a model property, not a serving-path one: with random weights the
+        two-stage proposal top-k amplifies int8-sized score shifts into
+        entirely different ROI sets, unlike the single-stage SSD test
+        above.)"""
+        import cv2
+
+        from analytics_zoo_tpu.data import SSDByteRecord
+        from analytics_zoo_tpu.models import FasterRcnnDetector, FrcnnParam
+        from analytics_zoo_tpu.ops import ProposalParam
+        from analytics_zoo_tpu.pipelines.frcnn import FrcnnPredictor
+        from analytics_zoo_tpu.pipelines.ssd import PreProcessParam
+
+        rng = np.random.RandomState(5)
+        det = FasterRcnnDetector(param=FrcnnParam(
+            num_classes=3, proposal=ProposalParam(pre_nms_topn=64,
+                                                  post_nms_topn=16)))
+        x0 = jnp.zeros((1, 128, 128, 3))
+        info0 = jnp.asarray([[128.0, 128.0, 1.0]])
+        variables = det.init(jax.random.PRNGKey(0), x0, info0)
+
+        recs = []
+        for i in range(2):
+            img = rng.randint(0, 255, (100, 80, 3), np.uint8)
+            _, buf = cv2.imencode(".jpg", img)
+            recs.append(SSDByteRecord(data=buf.tobytes(), path=f"{i}.jpg"))
+        param = PreProcessParam(batch_size=2, resolution=128)
+
+        # full precision: the two differently-compiled programs (dequant
+        # fused into convs vs precomputed fp32 weights) must not diverge
+        # in low-order bf16 bits that the proposal top-k would amplify
+        with jax.default_matmul_precision("float32"):
+            qp = FrcnnPredictor(det, variables, param, quantize=True)
+            assert any("int8" in str(l.dtype) for l in
+                       jax.tree_util.tree_leaves(qp.variables))
+            quant = qp.predict(recs)
+
+            dq_vars = dequantize_params(qp.variables)
+            base = FrcnnPredictor(det, dq_vars, param).predict(recs)
+        assert len(base) == len(quant) == 2
+        for b, q in zip(base, quant):
+            assert b.shape == q.shape
+            np.testing.assert_allclose(q, b, rtol=1e-4, atol=1e-4)
+
     def test_fp32_predictor_sees_later_weight_loads(self):
         """fp32 path must read model.variables at CALL time: weights
         loaded after predictor construction take effect."""
