@@ -85,7 +85,8 @@ def megatron_tp_rules(col: Sequence[str], row: Sequence[str],
             name_rule(row, _contract_dim(axis))]
 
 
-def ssd_tp_rules(axis: str = MODEL_AXIS) -> List[Rule]:
+def ssd_tp_rules(axis: str = MODEL_AXIS,
+                 resolution: int = 300) -> List[Rule]:
     """Tensor-parallel rules tuned to the SSDVgg topology.
 
     The generic ``default_tp_rules`` col-shards EVERY kernel — but the
@@ -114,6 +115,15 @@ def ssd_tp_rules(axis: str = MODEL_AXIS) -> List[Rule]:
         "loc_0", "loc_1", "loc_2", "loc_3", "loc_4", "loc_5",
         "conf_0", "conf_1", "conf_2", "conf_3", "conf_4", "conf_5",
     ]
+    if resolution != 300:
+        # SSD512 adds one extra block + a 7th head pair, same pairing.
+        # Mirror the MODEL's branch (models/ssd.py ExtraLayers builds the
+        # conv10/7-source topology for any resolution != 300) — an
+        # inverted guard would hand a 512-topology model the 300 rule
+        # set, recreating the replicated-kernel-fed-by-sharded-input
+        # rematerialization this module exists to avoid.
+        col.append("conv10_2")
+        row += ["conv10_1", "loc_6", "conf_6"]
     return megatron_tp_rules(col, row, axis)
 
 

@@ -88,6 +88,21 @@ class TestMegatronRules:
                               mesh, rules)
         assert spec == P(None, None, "model", None)
 
+    def test_ssd512_rules_cover_extra_block_and_head(self):
+        from analytics_zoo_tpu.parallel import ssd_tp_rules
+
+        mesh = create_mesh((2, 4), axis_names=("data", "model"))
+        rules = ssd_tp_rules(resolution=512)
+        # conv10_2 is a head source → column; conf_6 consumes it → row
+        assert partition_spec("params/extra/conv10_2/kernel",
+                              (4, 4, 128, 256), mesh, rules) \
+            == P(None, None, None, "model")
+        assert partition_spec("params/conf_6/kernel", (3, 3, 256, 84),
+                              mesh, rules) == P(None, None, "model", None)
+        # the 300 rule set leaves them unmatched (replicated)
+        assert partition_spec("params/conf_6/kernel", (3, 3, 256, 84),
+                              mesh, ssd_tp_rules()) == P()
+
     def test_megatron_rules_dense_contract_dim(self):
         from analytics_zoo_tpu.parallel import megatron_tp_rules
 
