@@ -103,7 +103,18 @@ class FasterRcnnVgg(nn.Module):
     param: FrcnnParam = FrcnnParam()
 
     @nn.compact
-    def __call__(self, x, im_info, train: bool = False):
+    def __call__(self, x, im_info, train: bool = False,
+                 extra_rois=None, extra_rois_mask=None,
+                 train_outputs: bool = False):
+        """``extra_rois`` (B, G, 4) + mask appends known boxes (the gt —
+        py-faster-rcnn's sampling trick guaranteeing foreground ROIs
+        early in training) to the proposals before pooling.
+        ``train_outputs=True`` returns the dict
+        ``ops.frcnn_train.frcnn_training_loss`` consumes (raw RPN/head
+        logits + anchors) instead of the inference tuple; ROIs are
+        stop-gradiented (approximate joint training — the reference's
+        proposal layer cannot backprop at all,
+        ``common/nn/Proposal.scala``)."""
         p = self.param
         feat = FrcnnVggTrunk(name="vgg")(x)                # (B, h, w, 512)
         B, h, w, _ = feat.shape
@@ -127,10 +138,18 @@ class FasterRcnnVgg(nn.Module):
             h, w, p.feat_stride))                              # (h·w·A, 4)
 
         def one(s, d, info):
-            return proposal(s, d, anchors, info[0], info[1], info[2],
+            return proposal(jax.lax.stop_gradient(s),
+                            jax.lax.stop_gradient(d), anchors,
+                            info[0], info[1], info[2],
                             param=p.proposal)
 
         rois, roi_mask = jax.vmap(one)(scores, deltas, im_info)
+        if extra_rois is not None:
+            rois = jnp.concatenate([rois, extra_rois], axis=1)
+            roi_mask = jnp.concatenate(
+                [roi_mask, extra_rois_mask.astype(roi_mask.dtype)], axis=1)
+        rois = jax.lax.stop_gradient(rois)
+        roi_mask = jax.lax.stop_gradient(roi_mask)
 
         pooled = roi_pool_batch(feat, rois, roi_mask, pooled_h=p.pooled,
                                 pooled_w=p.pooled,
@@ -142,9 +161,22 @@ class FasterRcnnVgg(nn.Module):
         y = nn.Dropout(0.5, deterministic=not train)(y)
         y = nn.relu(nn.Dense(4096, name="fc7")(y))
         y = nn.Dropout(0.5, deterministic=not train)(y)
-        cls_probs = jax.nn.softmax(
-            nn.Dense(p.num_classes, name="cls_score")(y), axis=-1)
+        cls_logits = nn.Dense(p.num_classes, name="cls_score")(y)
         bbox_deltas = nn.Dense(p.num_classes * 4, name="bbox_pred")(y)
+        if train_outputs:
+            return {
+                "rpn_cls_logits": cls_pair.reshape(
+                    B, h * w, 2, p.num_anchors).transpose(0, 1, 3, 2)
+                    .reshape(B, -1, 2),
+                "rpn_deltas": deltas,
+                "fg_scores": scores,
+                "anchors": anchors,
+                "rois": rois,
+                "roi_mask": roi_mask,
+                "cls_logits": cls_logits,
+                "bbox_deltas": bbox_deltas,
+            }
+        cls_probs = jax.nn.softmax(cls_logits, axis=-1)
         return rois, roi_mask, cls_probs, bbox_deltas
 
 

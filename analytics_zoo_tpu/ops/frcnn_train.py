@@ -1,0 +1,182 @@
+"""Faster-RCNN TRAINING targets and losses — net-new capability.
+
+The reference cannot train Faster-RCNN at all: its proposal layer throws
+on backward (``common/nn/Proposal.scala`` ``updateGradInput`` is
+unsupported) and its importer only ever loads py-faster-rcnn
+caffemodels for inference.  This module supplies the approximate-joint
+training recipe of the Faster-RCNN paper in static-shape, jittable
+form:
+
+- :func:`rpn_targets` — per-anchor objectness labels (IoU ≥ 0.7 or
+  best-per-gt → positive, IoU < 0.3 → negative, cross-boundary anchors
+  ignored) and box-regression targets against the matched gt;
+- :func:`head_targets` — per-ROI class labels (IoU ≥ 0.5 → matched gt's
+  class, else background) and class-slot box targets;
+- both with fixed-size minibatch sampling done DETERMINISTICALLY via
+  ranked masks (positives by descending IoU, negatives hardest-first by
+  the current scores — SSD-style hard-negative mining instead of
+  py-faster-rcnn's random draw; random sampling needs per-step RNG
+  plumbing and mines easier negatives).  Ranks come from the
+  double-argsort trick, so every shape is static under jit;
+- :func:`frcnn_training_loss` — RPN softmax CE + smooth-L1 and head
+  softmax CE + class-slot smooth-L1, each normalized by its sampled
+  count (the paper's λ=1 balance).
+
+Gradients do NOT flow through proposal box coordinates (the caller
+stop-gradients ROIs — approximate joint training, as in py-faster-rcnn's
+end2end mode).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.core.criterion import smooth_l1
+from analytics_zoo_tpu.ops.bbox import bbox_transform, iou_matrix
+
+
+@dataclasses.dataclass(frozen=True)
+class FrcnnLossParam:
+    rpn_sample: int = 256
+    rpn_pos_frac: float = 0.5
+    rpn_pos_iou: float = 0.7
+    rpn_neg_iou: float = 0.3
+    head_sample: int = 128
+    head_pos_frac: float = 0.25
+    head_fg_iou: float = 0.5
+
+
+def _rank_desc(priority: jax.Array) -> jax.Array:
+    """rank[i] = position of i when sorting by priority DESCENDING
+    (double-argsort; static shapes)."""
+    order = jnp.argsort(-priority)
+    return jnp.argsort(order)
+
+
+def rpn_targets(anchors, gt, gt_mask, im_h, im_w, fg_scores,
+                p: FrcnnLossParam = FrcnnLossParam()
+                ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """(labels (N,), cls_w (N,), box_targets (N,4), box_w (N,)).
+
+    ``anchors`` (N,4) pixel boxes; ``gt`` (G,4) pixel boxes with
+    ``gt_mask`` (G,) validity; ``fg_scores`` (N,) current objectness
+    probabilities (hard-negative ranking).
+    """
+    N = anchors.shape[0]
+    iou = iou_matrix(anchors, gt, normalized=False)
+    iou = jnp.where(gt_mask[None, :] > 0, iou, 0.0)         # (N, G)
+    max_iou = iou.max(axis=1)
+    arg_gt = iou.argmax(axis=1)
+    inside = ((anchors[:, 0] >= 0) & (anchors[:, 1] >= 0)
+              & (anchors[:, 2] <= im_w - 1.0)
+              & (anchors[:, 3] <= im_h - 1.0))
+    # each gt's best anchor is positive even below the IoU bar.  max()
+    # scatter (bool OR), not set(): padded gts all argmax to anchor 0
+    # with a False value, and a duplicate-index set() could let that
+    # False overwrite a valid gt's True at the same anchor
+    best_anchor = iou.argmax(axis=0)                        # (G,)
+    best_iou = iou.max(axis=0)
+    is_best = jnp.zeros((N,), bool).at[best_anchor].max(
+        (gt_mask > 0) & (best_iou > 0), mode="drop")
+    pos = inside & ((max_iou >= p.rpn_pos_iou) | is_best)
+    neg = inside & (max_iou < p.rpn_neg_iou) & ~pos
+
+    n_pos_cap = int(p.rpn_sample * p.rpn_pos_frac)
+    pos_rank = _rank_desc(jnp.where(pos, max_iou, -jnp.inf))
+    sel_pos = pos & (pos_rank < n_pos_cap)
+    n_pos = jnp.sum(sel_pos)
+    # hardest negatives: highest current objectness first
+    neg_rank = _rank_desc(jnp.where(neg, fg_scores, -jnp.inf))
+    sel_neg = neg & (neg_rank < p.rpn_sample - n_pos)
+
+    labels = pos.astype(jnp.float32)
+    cls_w = (sel_pos | sel_neg).astype(jnp.float32)
+    box_targets = bbox_transform(anchors, gt[arg_gt])
+    return labels, cls_w, box_targets, sel_pos.astype(jnp.float32)
+
+
+def head_targets(rois, roi_mask, gt, gt_labels, gt_mask,
+                 bg_scores, p: FrcnnLossParam = FrcnnLossParam()
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """(labels (R,) int32, cls_w (R,), box_targets (R,4), box_w (R,)).
+
+    ``rois`` (R,4) pixel proposals with ``roi_mask`` validity;
+    ``gt_labels`` (G,) int class ids (0 = background is never a gt);
+    ``bg_scores`` (R,) current 1-P(background) for hard-negative
+    ranking.
+    """
+    iou = iou_matrix(rois, gt, normalized=False)
+    iou = jnp.where(gt_mask[None, :] > 0, iou, 0.0)         # (R, G)
+    max_iou = iou.max(axis=1)
+    arg_gt = iou.argmax(axis=1)
+    valid = roi_mask > 0
+    fg = valid & (max_iou >= p.head_fg_iou)
+    bg = valid & ~fg
+
+    n_fg_cap = int(p.head_sample * p.head_pos_frac)
+    fg_rank = _rank_desc(jnp.where(fg, max_iou, -jnp.inf))
+    sel_fg = fg & (fg_rank < n_fg_cap)
+    n_fg = jnp.sum(sel_fg)
+    bg_rank = _rank_desc(jnp.where(bg, bg_scores, -jnp.inf))
+    sel_bg = bg & (bg_rank < p.head_sample - n_fg)
+
+    labels = jnp.where(sel_fg, gt_labels[arg_gt].astype(jnp.int32), 0)
+    cls_w = (sel_fg | sel_bg).astype(jnp.float32)
+    box_targets = bbox_transform(rois, gt[arg_gt])
+    return labels, cls_w, box_targets, sel_fg.astype(jnp.float32)
+
+
+def _weighted_softmax_ce(logits, labels, w):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
+                             axis=-1)[..., 0]
+    return -jnp.sum(ll * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def frcnn_training_loss(outputs, batch,
+                        p: FrcnnLossParam = FrcnnLossParam()):
+    """Total loss from ``FasterRcnnVgg(..., train_outputs=True)`` output
+    and a batch with ``target`` = {bboxes (B,G,4) PIXEL coords at the
+    network input scale, labels (B,G) int, mask (B,G)} and ``im_info``
+    rows (h, w, ...).
+    """
+    rois = outputs["rois"]
+    aux = outputs
+    tgt = batch["target"]
+    im_info = batch["im_info"]
+    B = rois.shape[0]
+    C = outputs["cls_logits"].shape[-1]
+
+    def one(rpn_logits, rpn_deltas, fg_scores, rois_i, roi_mask_i,
+            cls_logits, bbox_deltas, gt, gt_labels, gt_mask, info):
+        labels, cls_w, box_t, box_w = rpn_targets(
+            aux["anchors"], gt, gt_mask, info[0], info[1], fg_scores, p)
+        rpn_cls = _weighted_softmax_ce(rpn_logits, labels, cls_w)
+        rpn_box = jnp.sum(smooth_l1(rpn_deltas - box_t)
+                          * box_w[:, None]) / jnp.maximum(
+            jnp.sum(cls_w), 1.0)
+
+        bg_scores = 1.0 - jax.nn.softmax(cls_logits, axis=-1)[:, 0]
+        hl, hw, hbox_t, hbox_w = head_targets(
+            rois_i, roi_mask_i, gt, gt_labels, gt_mask, bg_scores, p)
+        head_cls = _weighted_softmax_ce(cls_logits, hl, hw)
+        # box loss only on the target class's 4 slots
+        d = bbox_deltas.reshape(-1, C, 4)
+        d_cls = jnp.take_along_axis(
+            d, hl[:, None, None].astype(jnp.int32).repeat(4, axis=2),
+            axis=1)[:, 0]                                    # (R, 4)
+        head_box = jnp.sum(smooth_l1(d_cls - hbox_t)
+                           * hbox_w[:, None]) / jnp.maximum(
+            jnp.sum(hw), 1.0)
+        return rpn_cls + rpn_box + head_cls + head_box
+
+    losses = jax.vmap(one)(
+        outputs["rpn_cls_logits"], outputs["rpn_deltas"],
+        outputs["fg_scores"], rois, outputs["roi_mask"],
+        outputs["cls_logits"], outputs["bbox_deltas"],
+        tgt["bboxes"], tgt["labels"], tgt["mask"], im_info)
+    return jnp.mean(losses)

@@ -135,3 +135,78 @@ class FrcnnPredictor:
         return run_serving_loop(
             serving_chain(self.param, uint8=True, resize=resize)(records),
             self._detect_device, lambda t: self._rescale(*t))
+
+
+def frcnn_train_batches(dataset, resolution: int):
+    """Adapt SSD-style labeled batches (normalized gt) to the Faster-RCNN
+    train step's input contract: ``input`` becomes the forward tuple
+    ``(pixels, im_info, gt_px, gt_mask)`` — the gt boxes double as
+    ``extra_rois`` (py-faster-rcnn's guaranteed-foreground sampling
+    trick) — and ``target.bboxes`` is scaled to pixels for the target
+    assignment."""
+
+    class _DS:
+        def __iter__(self):
+            for b in dataset:
+                B = b["input"].shape[0]
+                gt_px = np.asarray(b["target"]["bboxes"],
+                                   np.float32) * resolution
+                im_info = np.tile(
+                    np.asarray([[resolution, resolution, 1.0]], np.float32),
+                    (B, 1))
+                yield {
+                    "input": (np.asarray(b["input"], np.float32), im_info,
+                              gt_px, np.asarray(b["target"]["mask"],
+                                                np.float32)),
+                    "im_info": im_info,
+                    "target": {
+                        "bboxes": gt_px,
+                        "labels": np.asarray(b["target"]["labels"],
+                                             np.int32),
+                        "mask": np.asarray(b["target"]["mask"],
+                                           np.float32),
+                    },
+                }
+
+    return _DS()
+
+
+def train_frcnn(model, dataset, resolution: int, epochs: int = 10,
+                lr: float = 1e-3, mesh=None, loss_param=None,
+                grad_clip_norm: Optional[float] = 10.0):
+    """End-to-end Faster-RCNN training — capability the REFERENCE DOES
+    NOT HAVE (its proposal layer throws on backward,
+    ``common/nn/Proposal.scala``; Faster-RCNN there is import-and-serve
+    only).  Approximate joint training: RPN objectness/box losses +
+    head class/box losses (``ops.frcnn_train``), gt boxes injected as
+    extra ROIs, deterministic hard-negative sampling.
+
+    ``model``: a ``core.Model`` wrapping ``FasterRcnnVgg``; ``dataset``
+    yields SSD-style labeled batches with NORMALIZED gt (e.g.
+    ``pipelines.ssd.load_train_set``) — adapted via
+    :func:`frcnn_train_batches`.
+    """
+    from analytics_zoo_tpu.ops.frcnn_train import (FrcnnLossParam,
+                                                   frcnn_training_loss)
+    from analytics_zoo_tpu.parallel import Optimizer, SGD, Trigger, create_mesh
+
+    loss_param = loss_param or FrcnnLossParam()
+    module = model.module
+
+    def forward_fn(variables, inputs, train=False, rngs=None):
+        x, im_info, gt_px, gt_mask = inputs
+        out = module.apply(variables, x, im_info, train=train,
+                           extra_rois=gt_px, extra_rois_mask=gt_mask,
+                           train_outputs=True, rngs=rngs)
+        return out, None
+
+    def criterion(outputs, batch):
+        return frcnn_training_loss(outputs, batch, loss_param)
+
+    opt = (Optimizer(model, frcnn_train_batches(dataset, resolution),
+                     criterion, mesh=mesh or create_mesh(),
+                     forward_fn=forward_fn, grad_clip_norm=grad_clip_norm)
+           .set_optim_method(SGD(lr, momentum=0.9))
+           .set_end_when(Trigger.max_epoch(epochs)))
+    opt.optimize()
+    return model

@@ -269,3 +269,50 @@ class TestBeamSearchDecode:
         out = DeepSpeech2Pipeline(model, param).transcribe_samples(
             {"a": rng.randn(16000).astype(np.float32) * 0.1})
         assert isinstance(out["a"], str)
+
+
+class TestEvaluateCtcDecoders:
+    """The shared held-out evaluation harness (used by train_ds2 and
+    train_attention_asr examples — one implementation so reports can't
+    drift)."""
+
+    def test_perfect_model_scores_zero_cer(self):
+        from analytics_zoo_tpu.transform.audio import (ALPHABET,
+                                                       evaluate_ctc_decoders)
+
+        # log-probs that spell each label sequence with blanks between
+        labels = np.asarray([[3, 5], [7, 2]], np.int32)
+        T, C = 8, len(ALPHABET)
+
+        def forward(x):
+            b = x.shape[0]
+            lp = np.full((b, T, C), -20.0, np.float32)
+            for i in range(b):
+                frames = [0, labels[i, 0], 0, labels[i, 1], 0, 0, 0, 0]
+                for t, tok in enumerate(frames):
+                    lp[i, t, tok] = 0.0
+            return lp
+
+        batches = [{"input": np.zeros((2, T, 1), np.float32),
+                    "labels": labels}]
+        m = evaluate_ctc_decoders(forward, batches)
+        assert m == {"cer": 0.0, "exact_sequence_acc": 1.0,
+                     "beam_cer": 0.0, "beam_exact_sequence_acc": 1.0,
+                     "sequences": 2}
+
+    def test_wrong_model_scores_nonzero_cer(self):
+        from analytics_zoo_tpu.transform.audio import (ALPHABET,
+                                                       evaluate_ctc_decoders)
+
+        T, C = 6, len(ALPHABET)
+
+        def forward(x):
+            lp = np.full((x.shape[0], T, C), -20.0, np.float32)
+            lp[:, :, 4] = 0.0                  # always emits token 4
+            return lp
+
+        batches = [{"input": np.zeros((1, T, 1), np.float32),
+                    "labels": np.asarray([[3, 5]], np.int32)}]
+        m = evaluate_ctc_decoders(forward, batches)
+        assert m["cer"] > 0 and m["exact_sequence_acc"] == 0.0
+        assert m["sequences"] == 1

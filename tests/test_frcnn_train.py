@@ -1,0 +1,163 @@
+"""Faster-RCNN TRAINING (ops/frcnn_train.py + FasterRcnnVgg
+train_outputs): target assignment against hand-checked cases, and the
+four-loss objective decreasing through the Optimizer — net-new
+capability (the reference's proposal layer throws on backward)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.ops.frcnn_train import (
+    FrcnnLossParam,
+    frcnn_training_loss,
+    head_targets,
+    rpn_targets,
+    smooth_l1,
+)
+
+
+class TestSmoothL1:
+    def test_values(self):
+        x = jnp.asarray([-2.0, -0.5, 0.0, 0.5, 2.0])
+        np.testing.assert_allclose(
+            np.asarray(smooth_l1(x)), [1.5, 0.125, 0.0, 0.125, 1.5])
+
+
+class TestRpnTargets:
+    def test_hand_checked_assignment(self):
+        # 3 anchors: one ~= gt (IoU≈1), one half-overlap, one far away
+        anchors = jnp.asarray([[10, 10, 50, 50],
+                               [30, 10, 70, 50],
+                               [200, 200, 240, 240]], jnp.float32)
+        gt = jnp.asarray([[10, 10, 50, 50]], jnp.float32)
+        gt_mask = jnp.ones((1,))
+        labels, cls_w, box_t, box_w = rpn_targets(
+            anchors, gt, gt_mask, 300.0, 300.0,
+            fg_scores=jnp.asarray([0.9, 0.5, 0.1]))
+        labels, cls_w, box_w = map(np.asarray, (labels, cls_w, box_w))
+        assert labels[0] == 1 and box_w[0] == 1       # exact match → pos
+        assert labels[2] == 0 and cls_w[2] == 1       # far → sampled neg
+        # the exact-match positive's box target is the zero delta
+        np.testing.assert_allclose(np.asarray(box_t)[0], 0.0, atol=1e-6)
+
+    def test_best_anchor_positive_below_threshold(self):
+        # no anchor reaches 0.7 IoU; the best one must still be positive
+        anchors = jnp.asarray([[0, 0, 30, 30], [60, 60, 90, 90]],
+                              jnp.float32)
+        gt = jnp.asarray([[10, 10, 45, 45]], jnp.float32)
+        labels, cls_w, _, box_w = rpn_targets(
+            anchors, gt, jnp.ones((1,)), 100.0, 100.0,
+            fg_scores=jnp.zeros((2,)))
+        assert np.asarray(labels)[0] == 1 and np.asarray(box_w)[0] == 1
+
+    def test_cross_boundary_anchor_ignored(self):
+        anchors = jnp.asarray([[-5, 10, 50, 50],     # crosses x=0
+                               [10, 10, 50, 50]], jnp.float32)
+        gt = jnp.asarray([[10, 10, 50, 50]], jnp.float32)
+        labels, cls_w, _, box_w = rpn_targets(
+            anchors, gt, jnp.ones((1,)), 300.0, 300.0,
+            fg_scores=jnp.zeros((2,)))
+        assert np.asarray(cls_w)[0] == 0 and np.asarray(box_w)[0] == 0
+
+    def test_sample_caps_respected(self):
+        rng = np.random.RandomState(0)
+        N = 600
+        anchors = jnp.asarray(
+            np.stack([rng.rand(N) * 200, rng.rand(N) * 200,
+                      rng.rand(N) * 200 + 30, rng.rand(N) * 200 + 30],
+                     axis=1), jnp.float32)
+        gt = jnp.asarray([[50, 50, 120, 120]], jnp.float32)
+        p = FrcnnLossParam(rpn_sample=64, rpn_pos_frac=0.5)
+        labels, cls_w, _, box_w = rpn_targets(
+            anchors, gt, jnp.ones((1,)), 300.0, 300.0,
+            fg_scores=jnp.asarray(rng.rand(N), jnp.float32), p=p)
+        assert float(jnp.sum(cls_w)) <= 64
+        assert float(jnp.sum(box_w)) <= 32
+
+
+class TestHeadTargets:
+    def test_fg_gets_gt_class_bg_gets_zero(self):
+        rois = jnp.asarray([[10, 10, 50, 50],        # IoU 1 with gt 0
+                            [200, 200, 240, 240]], jnp.float32)
+        gt = jnp.asarray([[10, 10, 50, 50]], jnp.float32)
+        gt_labels = jnp.asarray([3], jnp.int32)
+        labels, cls_w, box_t, box_w = head_targets(
+            rois, jnp.ones((2,)), gt, gt_labels, jnp.ones((1,)),
+            bg_scores=jnp.asarray([0.5, 0.5]))
+        labels = np.asarray(labels)
+        assert labels[0] == 3 and labels[1] == 0
+        assert np.asarray(box_w)[0] == 1 and np.asarray(box_w)[1] == 0
+        np.testing.assert_allclose(np.asarray(box_t)[0], 0.0, atol=1e-6)
+
+    def test_invalid_rois_never_sampled(self):
+        rois = jnp.asarray([[10, 10, 50, 50], [0, 0, 0, 0]], jnp.float32)
+        labels, cls_w, _, _ = head_targets(
+            rois, jnp.asarray([1.0, 0.0]),
+            jnp.asarray([[10, 10, 50, 50]], jnp.float32),
+            jnp.asarray([2], jnp.int32), jnp.ones((1,)),
+            bg_scores=jnp.asarray([0.5, 0.9]))
+        assert np.asarray(cls_w)[1] == 0
+
+
+class TestFrcnnTrainStep:
+    def test_loss_decreases_through_optimizer(self):
+        """Tiny Faster-RCNN trains end-to-end on a 2-box synthetic task
+        through pipelines.frcnn.train_frcnn; total loss decreases."""
+        from analytics_zoo_tpu.core.module import Model
+        from analytics_zoo_tpu.models import FasterRcnnVgg, FrcnnParam
+        from analytics_zoo_tpu.ops import ProposalParam
+        from analytics_zoo_tpu.ops.frcnn_train import frcnn_training_loss
+        from analytics_zoo_tpu.pipelines.frcnn import (frcnn_train_batches,
+                                                       train_frcnn)
+
+        rng = np.random.RandomState(0)
+        B, RES, G = 2, 64, 2
+        # bright rectangles on dark background, gt normalized
+        batches = []
+        for _ in range(2):
+            imgs = rng.rand(B, RES, RES, 3).astype(np.float32) * 10
+            bboxes = np.zeros((B, G, 4), np.float32)
+            labels = np.zeros((B, G), np.int32)
+            for b in range(B):
+                for g in range(G):
+                    x1, y1 = rng.randint(2, 30, 2)
+                    w, h = rng.randint(16, 28, 2)
+                    x2, y2 = min(x1 + w, RES - 2), min(y1 + h, RES - 2)
+                    imgs[b, y1:y2, x1:x2] += 120.0
+                    bboxes[b, g] = (x1 / RES, y1 / RES, x2 / RES, y2 / RES)
+                    labels[b, g] = 1 + (g % 2)
+            batches.append({"input": imgs,
+                            "target": {"bboxes": bboxes, "labels": labels,
+                                       "mask": np.ones((B, G),
+                                                       np.float32)}})
+
+        param = FrcnnParam(num_classes=3,
+                           proposal=ProposalParam(pre_nms_topn=128,
+                                                  post_nms_topn=32))
+        model = Model(FasterRcnnVgg(param=param))
+        model.build(0, jnp.zeros((1, RES, RES, 3), jnp.float32),
+                    jnp.asarray([[RES, RES, 1.0]], jnp.float32))
+
+        def eval_loss(m):
+            tot = 0.0
+            for fb in frcnn_train_batches(iter(batches), RES):
+                x, info, gt_px, gt_mask = fb["input"]
+                out = m.module.apply(
+                    m.variables, jnp.asarray(x), jnp.asarray(info),
+                    extra_rois=jnp.asarray(gt_px),
+                    extra_rois_mask=jnp.asarray(gt_mask),
+                    train_outputs=True)
+                tot += float(frcnn_training_loss(out, fb))
+            return tot / len(batches)
+
+        from analytics_zoo_tpu.parallel import create_mesh
+
+        loss0 = eval_loss(model)
+        train_frcnn(model, batches, RES, epochs=4, lr=3e-3,
+                    mesh=create_mesh((2,), axis_names=("data",),
+                                     devices=jax.devices()[:2]))
+        loss1 = eval_loss(model)
+        assert np.isfinite(loss0) and np.isfinite(loss1)
+        assert loss1 < loss0, (loss0, loss1)
