@@ -182,17 +182,27 @@ def train_transformer(param: PreProcessParam) -> Transformer:
     )
 
 
-def val_transformer(param: PreProcessParam) -> Transformer:
-    """Validation chain without augmentation (reference ``loadValSet:72``)."""
-    return (
+def val_transformer(param: PreProcessParam,
+                    flip: bool = False) -> Transformer:
+    """Validation chain without augmentation (reference ``loadValSet:72``).
+
+    ``flip=True`` inserts a random horizontal flip before the float
+    extraction — the resize-only TRAIN chain
+    (``load_train_set(augment=False)``) shares this one implementation
+    so train/val preprocessing can never skew."""
+    chain = (
         RecordToFeature()
         >> BytesToMat()
         >> RoiNormalize()
         >> Resize(param.resolution, param.resolution)
-        >> MatToFloats(mean=param.pixel_means,
-                       valid_height=param.resolution,
-                       valid_width=param.resolution)
     )
+    if flip:
+        # before MatToFloats: the float tensor is extracted there, so a
+        # later mat flip would desync pixels from the flipped labels
+        chain = chain >> RandomTransformer(HFlip() >> RoiHFlip(), 0.5)
+    return chain >> MatToFloats(mean=param.pixel_means,
+                                valid_height=param.resolution,
+                                valid_width=param.resolution)
 
 
 def _maybe_parallel(t: Transformer, workers: int) -> Transformer:
@@ -231,13 +241,22 @@ def load_train_set_device(pattern: str, param: PreProcessParam,
     return ds, make_device_augment(aug)
 
 
-def load_train_set(pattern: str, param: PreProcessParam) -> DataSet:
+def load_train_set(pattern: str, param: PreProcessParam,
+                   augment: bool = True) -> DataSet:
+    """``augment=False`` keeps the TRAINING conveniences (file shuffling,
+    shuffle buffer, random flip, drop_remainder batching — one compiled
+    shape) but swaps the heavy geometric chain (Expand zoom-out + crop
+    samplers) for a plain resize: detectors whose feature stride is
+    coarse relative to the image (e.g. Faster-RCNN at small
+    resolutions) lose their objects below the feature grid under
+    zoom-out augmentation."""
     ds = DataSet.from_record_files(pattern, SSDByteRecord.decode,
                                    shuffle_files=True)
     if param.shuffle_buffer:
         ds = ds.shuffle(param.shuffle_buffer, seed=param.shuffle_seed)
-    return (ds.transform(_maybe_parallel(train_transformer(param),
-                                         param.num_workers))
+    chain = (train_transformer(param) if augment
+             else val_transformer(param, flip=True))
+    return (ds.transform(_maybe_parallel(chain, param.num_workers))
             .transform(RoiImageToBatch(param.batch_size, param.max_gt)))
 
 

@@ -34,6 +34,13 @@ def main():
     p.add_argument("--lr", type=float, default=2e-3)
     p.add_argument("--pre-nms", type=int, default=512)
     p.add_argument("--post-nms", type=int, default=64)
+    p.add_argument("--anchor-scales", type=float, nargs="+",
+                   default=[1, 2, 4],
+                   help="anchor side = scale*16px.  The py-faster-rcnn "
+                        "default (8,16,32) is sized for ~600px inputs; "
+                        "at small --res those anchors all hang off the "
+                        "image, every one is cross-boundary-ignored, and "
+                        "the RPN never gets a positive")
     p.add_argument("--out", default=None)
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
@@ -57,6 +64,7 @@ def main():
     classes = ["__background__", "rectangle", "ellipse", "triangle"]
     param = FrcnnParam(
         num_classes=len(classes),
+        anchor_scales=tuple(args.anchor_scales),
         proposal=ProposalParam(pre_nms_topn=args.pre_nms,
                                post_nms_topn=args.post_nms))
 
@@ -69,7 +77,12 @@ def main():
             resolution=args.res, num_shards=2, seed=100)
         pp = PreProcessParam(batch_size=args.batch_size,
                              resolution=args.res, max_gt=8)
-        train_set = load_train_set(os.path.join(tmp, "train-*.azr"), pp)
+        # augment=False: shuffled + flipped but NO Expand/zoom-out — that
+        # chain shrinks objects well below the stride-16 feature grid at
+        # small --res (observed 7px gt = half a feature cell, invisible
+        # to RPN anchors and ROI pooling)
+        train_set = load_train_set(os.path.join(tmp, "train-*.azr"), pp,
+                                   augment=False)
         val_set = load_val_set(os.path.join(tmp, "val-*.azr"), pp)
 
         model = Model(FasterRcnnVgg(param=param))
