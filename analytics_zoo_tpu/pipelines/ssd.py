@@ -97,6 +97,9 @@ class PreProcessParam:
     # canvas cuts host→device transfer bytes (the staging tensor is the
     # whole uint8 canvas) at the cost of resolution for oversized images.
     canvas_size: Optional[int] = None
+    # staged-pixel wire format for the device-aug path ("bgr" | "yuv420");
+    # see DeviceAugParam.wire_format — "yuv420" halves host→device bytes
+    wire_format: str = "bgr"
 
 
 class RecordToFeature(Transformer):
@@ -229,7 +232,8 @@ def load_train_set_device(pattern: str, param: PreProcessParam,
         extra = ({"canvas_size": param.canvas_size}
                  if param.canvas_size else {})
         aug = DeviceAugParam(resolution=param.resolution,
-                             pixel_means=tuple(param.pixel_means), **extra)
+                             pixel_means=tuple(param.pixel_means),
+                             wire_format=param.wire_format, **extra)
     chain = (RecordToFeature() >> BytesToMat(to_float=False) >> RoiNormalize()
              >> DeviceAugPrepare(aug))
     ds = DataSet.from_record_files(pattern, SSDByteRecord.decode,

@@ -55,6 +55,25 @@ class DeviceAugParam:
     resolution: int = 300
     canvas_size: int = 512          # fixed host→device staging canvas
     pixel_means: Sequence[float] = BGR_MEANS
+    # Host→device wire format for the staged pixels.  "bgr" ships the
+    # uint8 canvas as-is (3 bytes/px).  "yuv420" ships a full-res luma
+    # plane plus 2×2-subsampled chroma (1.5 bytes/px — the same
+    # decimation JPEG itself stores, so for JPEG-sourced images the
+    # extra loss is ~quantization only) and reconstructs BGR on-device
+    # inside the fused augmentation program.  Halves host→device bytes:
+    # the lever when the input link (PCIe, or a tunneled relay) — not
+    # host CPU — bounds end-to-end training throughput.
+    wire_format: str = "bgr"
+
+    def __post_init__(self):
+        # fail fast: inside the pipeline these would be caught by the
+        # per-record exception isolator and silently drop every record
+        if self.wire_format not in ("bgr", "yuv420"):
+            raise ValueError(f"unknown wire_format {self.wire_format!r}; "
+                             "expected 'bgr' or 'yuv420'")
+        if self.wire_format == "yuv420" and self.canvas_size % 2:
+            raise ValueError("yuv420 wire format needs an even "
+                             f"canvas_size, got {self.canvas_size}")
     expand_prob: float = 0.5
     max_expand_ratio: float = 4.0
     hflip_prob: float = 0.5
@@ -169,10 +188,25 @@ class DeviceAugPrepare(FeatureTransformer):
         jitter[4] = (random.uniform(-p.hue_delta, p.hue_delta)
                      if rr() < p.hue_prob else 0.0)
 
-        canvas = np.zeros((p.canvas_size, p.canvas_size, 3), np.uint8)
-        canvas[:h, :w] = mat
+        if p.wire_format == "yuv420":
+            import cv2
+
+            S = p.canvas_size
+            ycrcb = cv2.cvtColor(mat, cv2.COLOR_BGR2YCrCb)
+            ch, cw = (h + 1) // 2, (w + 1) // 2
+            chroma = cv2.resize(ycrcb[:, :, 1:], (cw, ch),
+                                interpolation=cv2.INTER_AREA)
+            y_canvas = np.zeros((S, S), np.uint8)
+            y_canvas[:h, :w] = ycrcb[:, :, 0]
+            uv_canvas = np.zeros((S // 2, S // 2, 2), np.uint8)
+            uv_canvas[:ch, :cw] = chroma.reshape(ch, cw, 2)
+            staged = {"y": y_canvas, "uv": uv_canvas}
+        else:
+            canvas = np.zeros((p.canvas_size, p.canvas_size, 3), np.uint8)
+            canvas[:h, :w] = mat
+            staged = {"canvas": canvas}
         return {
-            "canvas": canvas,
+            **staged,
             "rect": rect,
             "size": np.array([h, w], np.float32),
             "flip": np.float32(1.0 if flip else 0.0),
@@ -215,14 +249,16 @@ class DeviceAugBatch(FeatureTransformer):
         b, mask = pad_ragged(boxes, self.max_gt)
         l, _ = pad_ragged(labels, self.max_gt)
         dd, _ = pad_ragged(diff, self.max_gt)
+        pixel_keys = ("y", "uv") if "y" in ds[0] else ("canvas",)
+        aug = {k: np.stack([d[k] for d in ds]) for k in pixel_keys}
+        aug.update({
+            "rect": np.stack([d["rect"] for d in ds]),
+            "size": np.stack([d["size"] for d in ds]),
+            "flip": np.stack([d["flip"] for d in ds]),
+            "jitter": np.stack([d["jitter"] for d in ds]),
+        })
         return {
-            "aug": {
-                "canvas": np.stack([d["canvas"] for d in ds]),
-                "rect": np.stack([d["rect"] for d in ds]),
-                "size": np.stack([d["size"] for d in ds]),
-                "flip": np.stack([d["flip"] for d in ds]),
-                "jitter": np.stack([d["jitter"] for d in ds]),
-            },
+            "aug": aug,
             "im_info": np.stack([d["im_info"] for d in ds]),
             "target": {
                 "bboxes": b, "labels": l[..., 0].astype(np.int32),
@@ -349,9 +385,9 @@ def make_device_augment(param: DeviceAugParam, compute_dtype=None):
     # into the jitted augment degrades the remote-TPU transfer path
     means = np.asarray(param.pixel_means, np.float32)
     res = param.resolution
+    yuv = param.wire_format == "yuv420"
 
-    def one(canvas, rect, size, flip, jitter):
-        img = canvas.astype(jnp.float32)
+    def finish(img, rect, size, flip, jitter):
         img = _jitter_one(img, jitter)
         out = _sample_one(img, rect, size, flip, res, means)
         out = out - means
@@ -359,14 +395,33 @@ def make_device_augment(param: DeviceAugParam, compute_dtype=None):
             out = out.astype(compute_dtype)
         return out
 
-    vone = jax.vmap(one)
+    def one_bgr(canvas, rect, size, flip, jitter):
+        return finish(canvas.astype(jnp.float32), rect, size, flip, jitter)
+
+    def one_yuv(y, uv, rect, size, flip, jitter):
+        # Reconstruct the uint8 BGR canvas on-device: nearest 2× chroma
+        # upsample + OpenCV's full-range BT.601 YCrCb→BGR affine, clipped
+        # to [0,255] to keep uint8-canvas semantics for the jitter math.
+        yf = y.astype(jnp.float32)
+        uvf = uv.astype(jnp.float32)
+        uvf = jnp.repeat(jnp.repeat(uvf, 2, axis=0), 2, axis=1)
+        cr = uvf[..., 0] - 128.0
+        cb = uvf[..., 1] - 128.0
+        img = jnp.stack([yf + 1.773 * cb,                    # B
+                         yf - 0.714 * cr - 0.344 * cb,       # G
+                         yf + 1.403 * cr], axis=-1)          # R
+        img = jnp.clip(img, 0.0, 255.0)
+        return finish(img, rect, size, flip, jitter)
+
+    vone = jax.vmap(one_yuv if yuv else one_bgr)
 
     @jax.jit
     def augment(batch):
         aug = batch["aug"]
         out = dict(batch)
         out.pop("aug")
-        out["input"] = vone(aug["canvas"], aug["rect"], aug["size"],
+        pixels = ((aug["y"], aug["uv"]) if yuv else (aug["canvas"],))
+        out["input"] = vone(*pixels, aug["rect"], aug["size"],
                             aug["flip"], aug["jitter"])
         return out
 

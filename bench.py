@@ -101,10 +101,14 @@ def bench_ssd_train(args, mesh, shard_pattern, device_aug: bool):
     state = replicate(create_train_state(model, optim), mesh)
 
     # bench records are exactly res×res, so a tight staging canvas is
-    # lossless and cuts host→device bytes ~2.8× vs the 512 default
+    # lossless and cuts host→device bytes ~2.8× vs the 512 default;
+    # the yuv420 wire format halves the remaining bytes again (the
+    # e2e path is input-link-bound, not host-CPU-bound — measured:
+    # the host chain alone does ~700 img/s single-threaded)
     param = PreProcessParam(batch_size=args.batch, resolution=res,
                             num_workers=args.workers, max_gt=8,
-                            canvas_size=((res + 7) // 8) * 8)
+                            canvas_size=((res + 7) // 8) * 8,
+                            wire_format=args.wire_format)
     if device_aug:
         dataset, augment = load_train_set_device(shard_pattern, param)
     else:
@@ -176,6 +180,7 @@ def bench_ssd_train(args, mesh, shard_pattern, device_aug: bool):
         _emit(f"ssd{res}_train_step_images_per_sec_per_chip",
               step_per_chip, "images/sec/chip",
               step_per_chip / ROUND1_TRAIN_IMG_S if res == 300 else None,
+              batch=args.batch,
               note="device step only (batch re-fed) — input pipeline "
                    "excluded; vs_baseline = vs round-1 synthetic harness "
                    "(fp32→bf16)")
@@ -186,7 +191,7 @@ def bench_ssd_train(args, mesh, shard_pattern, device_aug: bool):
             _emit(f"ssd{res}_train_model_tflops_per_chip", tflops,
                   "TFLOP/s/chip", tflops / peak if peak else None,
                   mfu=round(tflops / peak, 4) if peak else None,
-                  peak_tflops=peak, device_kind=kind,
+                  peak_tflops=peak, device_kind=kind, batch=args.batch,
                   note="fwd+bwd+update FLOPs from XLA compiled "
                        "cost_analysis over the compute-only step time; "
                        "vs_baseline = MFU against advertised bf16 peak")
@@ -370,8 +375,14 @@ def bench_ds2(args, mesh):
 
 def main() -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--batch", type=int, default=128)   # MFU knee (see
+    # MFU_PROFILE.json batch sweep: 0.39 @ 32 → 0.54 @ 128); the
+    # reference's own train config used batch 112 (ssd/README.md)
     p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--wire-format", choices=("bgr", "yuv420"),
+                   default="yuv420",
+                   help="staged-pixel host→device wire format for the "
+                        "device-aug train phase (yuv420 = 1.5 B/px)")
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--res", type=int, default=300)
     p.add_argument("--classes", type=int, default=21)
@@ -535,6 +546,7 @@ def main() -> int:
                   (total / REFERENCE_ANCHOR_IMAGES_PER_SEC
                    if args.res == 300 else None),
                   final_loss=round(float(loss), 3),
+                  batch=args.batch, wire_format=args.wire_format,
                   vs_round1_synthetic=(
                       round(per_chip / ROUND1_TRAIN_IMG_S, 3)
                       if args.res == 300 else None),

@@ -137,6 +137,71 @@ def test_device_aug_end_to_end():
     assert x.min() >= -300 and x.max() <= 300
 
 
+def test_yuv420_reconstruction_matches_cv2_roundtrip():
+    """Device YCrCb→BGR affine + nearest chroma upsample vs the exact
+    same decimation done by OpenCV on host: flat regions are ~exact,
+    a smooth gradient stays within interpolation error."""
+    rng = np.random.RandomState(4)
+    flat = np.tile(rng.randint(0, 256, (1, 1, 3), np.uint8), (32, 32, 1))
+    gx, gy = np.meshgrid(np.linspace(0, 255, 32), np.linspace(0, 255, 32))
+    grad = np.stack([gx, gy, np.full((32, 32), 128.0)],
+                    axis=-1).astype(np.uint8)
+    for img, tol in ((flat, 3.0), (grad, 8.0)):
+        h, w = img.shape[:2]
+        param = DeviceAugParam(resolution=32, canvas_size=32,
+                               wire_format="yuv420")
+        prep = DeviceAugPrepare(param)
+        ycrcb = cv2.cvtColor(img, cv2.COLOR_BGR2YCrCb)
+        chroma = cv2.resize(ycrcb[:, :, 1:], (w // 2, h // 2),
+                            interpolation=cv2.INTER_AREA)
+        # device-side reconstruction (mirrors one_yuv's affine)
+        uvf = np.repeat(np.repeat(chroma.astype(np.float32), 2, 0), 2, 1)
+        cr, cb = uvf[..., 0] - 128.0, uvf[..., 1] - 128.0
+        yf = ycrcb[:, :, 0].astype(np.float32)
+        recon = np.clip(np.stack([yf + 1.773 * cb,
+                                  yf - 0.714 * cr - 0.344 * cb,
+                                  yf + 1.403 * cr], -1), 0, 255)
+        assert np.abs(recon - img.astype(np.float32)).mean() <= tol
+
+
+def test_yuv420_wire_parity_and_size():
+    """End-to-end: the yuv420 wire path produces the same augmented batch
+    as the bgr path (same seeded random decisions) within chroma-
+    subsampling tolerance, at half the staged pixel bytes."""
+    import random
+
+    from analytics_zoo_tpu.data import generate_shapes_records, read_ssd_records
+    from analytics_zoo_tpu.pipelines.ssd import RecordToFeature
+    from analytics_zoo_tpu.transform.vision import BytesToMat, RoiNormalize
+
+    import os
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        paths = generate_shapes_records(os.path.join(tmp, "s"), n_images=8,
+                                        resolution=160, num_shards=1)
+        records = list(read_ssd_records(paths))
+
+    outs, nbytes = {}, {}
+    for wire in ("bgr", "yuv420"):
+        param = DeviceAugParam(resolution=96, canvas_size=192,
+                               wire_format=wire)
+        chain = (RecordToFeature() >> BytesToMat() >> RoiNormalize()
+                 >> DeviceAugPrepare(param) >> DeviceAugBatch(4, max_gt=8))
+        random.seed(123)            # identical geometry/jitter decisions
+        batches = list(chain(records))
+        assert batches
+        nbytes[wire] = sum(v.nbytes for k, v in batches[0]["aug"].items()
+                           if k in ("canvas", "y", "uv"))
+        augment = make_device_augment(param)
+        outs[wire] = np.asarray(augment(batches[0])["input"])
+
+    assert nbytes["yuv420"] * 2 == nbytes["bgr"]
+    diff = np.abs(outs["yuv420"] - outs["bgr"])
+    assert diff.mean() <= 4.0       # chroma decimation error only
+    assert np.isfinite(outs["yuv420"]).all()
+
+
 def test_device_aug_pipeline_entry():
     import os
     import tempfile

@@ -23,7 +23,14 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
+
+# Self-contained path setup: PYTHONPATH=/root/repo breaks the axon TPU
+# plugin's entry-point discovery, so the repo root must be added at
+# runtime instead of via the environment.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def timed(fn, *args, iters=10):
