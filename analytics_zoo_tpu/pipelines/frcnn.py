@@ -71,6 +71,11 @@ class FrcnnPredictor:
                         "(swap_default_means=False keeps them)")
             param = dataclasses.replace(param,
                                         pixel_means=FRCNN_BGR_MEANS)
+        if param.wire_format != "bgr":
+            raise ValueError(
+                "FrcnnPredictor serves over the uint8 BGR wire only; "
+                f"wire_format={param.wire_format!r} is not supported "
+                "(the yuv420 wire is an SSDPredictor feature)")
         self.param = param
         self.aspect_preserving = aspect_preserving
         means = np.asarray(self.param.pixel_means, np.float32)

@@ -320,21 +320,51 @@ class SSDPredictor:
         of eager ops (the in-graph-DetectionOutput philosophy the
         reference applies by making post-processing a model layer,
         ``SSDGraph.scala``)."""
-        eval_step = self._eval_step
-        priors, variances = self._priors, self._variances
         means = np.asarray(self.param.pixel_means, np.float32)
+        tail = self._forward_tail
 
         def detect(variables, inputs, h, w, post):
             if inputs.dtype == jnp.uint8:
                 # uint8 staging path: normalize ON DEVICE (host sends 4×
                 # fewer bytes; MatToFloats semantics, in-graph)
                 inputs = inputs.astype(jnp.float32) - means
+            return tail(variables, inputs, h, w, post)
+
+        return jax.jit(detect, static_argnums=(4,))
+
+    @property
+    def _forward_tail(self):
+        """Shared post-input serving pipeline (forward + softmax +
+        DetectionOutput + rescale) closed over by every staging variant —
+        one place to change, no way for the wire paths to diverge."""
+        eval_step = self._eval_step
+        priors, variances = self._priors, self._variances
+
+        def tail(variables, inputs, h, w, post):
             loc, conf = eval_step(variables, inputs)
             probs = jax.nn.softmax(conf, axis=-1)
             dets = detection_output(loc, probs, priors, variances, post)
             return scale_detections(dets, h, w)
 
-        return jax.jit(detect, static_argnums=(4,))
+        return tail
+
+    @functools.cached_property
+    def _detect_yuv(self):
+        """yuv420-staged variant: the host ships Y + 2×2-subsampled
+        chroma (1.5 B/px — half the uint8 staging bytes); BGR
+        reconstruction, normalize, forward and DetectionOutput all run
+        in the ONE jitted program."""
+        from analytics_zoo_tpu.transform.vision.device import (
+            yuv420_to_bgr_device)
+
+        means = np.asarray(self.param.pixel_means, np.float32)
+        tail = self._forward_tail
+
+        def detect(variables, y, uv, h, w, post):
+            return tail(variables, yuv420_to_bgr_device(y, uv) - means,
+                        h, w, post)
+
+        return jax.jit(detect, static_argnums=(5,))
 
     def detect_normalized(self, inputs) -> jnp.ndarray:
         """Forward + softmax + DetectionOutput → (B, K, 6) normalized-box
@@ -356,6 +386,10 @@ class SSDPredictor:
         # (h, w, scale_h, scale_w); original = current / scale
         h = batch["im_info"][:, 0] / np.maximum(batch["im_info"][:, 2], 1e-8)
         w = batch["im_info"][:, 1] / np.maximum(batch["im_info"][:, 3], 1e-8)
+        if "input_uv" in batch:
+            return self._detect_yuv(variables, jnp.asarray(batch["input"]),
+                                    jnp.asarray(batch["input_uv"]),
+                                    jnp.asarray(h), jnp.asarray(w), self.post)
         return self._detect(variables, jnp.asarray(batch["input"]),
                             jnp.asarray(h), jnp.asarray(w), self.post)
 
@@ -386,10 +420,14 @@ class Uint8ToBatch(RoiImageToBatch):
     ``Convertor.scala:74-84``)."""
 
     def __init__(self, batch_size: int, resolution: int,
-                 drop_remainder: bool = False):
+                 drop_remainder: bool = False, wire_format: str = "bgr"):
         super().__init__(batch_size, keep_label=False,
                          drop_remainder=drop_remainder)
         self.resolution = resolution
+        if wire_format == "yuv420" and resolution % 2:
+            raise ValueError("yuv420 serving needs an even resolution, "
+                             f"got {resolution}")
+        self.wire_format = wire_format
 
     def _usable(self, f: ImageFeature) -> bool:
         return True                     # invalid → zero image in collate
@@ -405,29 +443,44 @@ class Uint8ToBatch(RoiImageToBatch):
             n = batch["input"].shape[0]
             if n < self.batch_size:
                 pad = self.batch_size - n
-                batch = {
-                    "input": np.concatenate(
-                        [batch["input"],
-                         np.zeros((pad,) + batch["input"].shape[1:],
-                                  batch["input"].dtype)]),
-                    "im_info": np.concatenate(
-                        [batch["im_info"],
-                         np.tile(np.array([[self.resolution,
-                                            self.resolution, 1.0, 1.0]],
-                                          np.float32), (pad, 1))]),
-                    "n_valid": n,
-                }
+
+                def _pad(arr, fill=0):
+                    return np.concatenate(
+                        [arr, np.full((pad,) + arr.shape[1:], fill,
+                                      arr.dtype)])
+
+                padded = {"input": _pad(batch["input"]),
+                          "im_info": np.concatenate(
+                              [batch["im_info"],
+                               np.tile(np.array([[self.resolution,
+                                                  self.resolution,
+                                                  1.0, 1.0]], np.float32),
+                                       (pad, 1))]),
+                          "n_valid": n}
+                if "input_uv" in batch:     # neutral chroma → black pixels
+                    padded["input_uv"] = _pad(batch["input_uv"], 128)
+                batch = padded
             yield batch
 
     def collate(self, feats: Sequence[ImageFeature]) -> Dict:
         res = self.resolution
-        zero = np.zeros((res, res, 3), np.uint8)
         default_info = np.array([res, res, 1.0, 1.0], np.float32)
-        mats, infos = [], []
-        for f in feats:
-            ok = f.is_valid and f.mat is not None
-            mats.append(f.mat if ok else zero)
-            infos.append(f.get_im_info() if ok else default_info)
+        infos = [f.get_im_info() if (f.is_valid and f.mat is not None)
+                 else default_info for f in feats]
+        if self.wire_format == "yuv420":
+            # planes were staged per-feature by Yuv420Staging INSIDE the
+            # (possibly parallel) chain; invalid records get black frames
+            zero_y = np.zeros((res, res), np.uint8)
+            zero_uv = np.full((res // 2, res // 2, 2), 128, np.uint8)
+            ys = [f.get("yuv_y", zero_y) if f.is_valid else zero_y
+                  for f in feats]
+            uvs = [f.get("yuv_uv", zero_uv) if f.is_valid else zero_uv
+                   for f in feats]
+            return {"input": np.stack(ys), "input_uv": np.stack(uvs),
+                    "im_info": np.stack(infos)}
+        zero = np.zeros((res, res, 3), np.uint8)
+        mats = [f.mat if (f.is_valid and f.mat is not None) else zero
+                for f in feats]
         return {"input": np.stack(mats), "im_info": np.stack(infos)}
 
 
@@ -445,8 +498,14 @@ def serving_chain(param: PreProcessParam, uint8: bool = False,
         chain = (RecordToFeature() >> BytesToMat(to_float=False)
                  >> (resize if resize is not None
                      else Resize(param.resolution, param.resolution)))
+        if param.wire_format == "yuv420":
+            from analytics_zoo_tpu.transform.vision.device import (
+                Yuv420Staging)
+
+            chain = chain >> Yuv420Staging()
         return (_maybe_parallel(chain, param.num_workers)
-                >> Uint8ToBatch(param.batch_size, param.resolution))
+                >> Uint8ToBatch(param.batch_size, param.resolution,
+                                wire_format=param.wire_format))
     return (_maybe_parallel(val_transformer(param), param.num_workers)
             >> RoiImageToBatch(param.batch_size, keep_label=False,
                                drop_remainder=False))

@@ -87,6 +87,55 @@ class DeviceAugParam:
     hue_delta: float = 18.0
 
 
+def bgr_to_yuv420_host(mat: np.ndarray):
+    """uint8 BGR (H,W,3) → (Y (H,W), CrCb (⌈H/2⌉,⌈W/2⌉,2)) uint8 planes:
+    full-range BT.601 luma plus 2×2 box-filtered chroma — the same
+    decimation a JPEG encoder applies, so for JPEG-sourced images the
+    round-trip loses ~quantization only."""
+    import cv2
+
+    h, w = mat.shape[:2]
+    ycrcb = cv2.cvtColor(mat, cv2.COLOR_BGR2YCrCb)
+    chroma = cv2.resize(ycrcb[:, :, 1:], ((w + 1) // 2, (h + 1) // 2),
+                        interpolation=cv2.INTER_AREA)
+    return ycrcb[:, :, 0], chroma.reshape((h + 1) // 2, (w + 1) // 2, 2)
+
+
+def yuv420_to_bgr_device(y, uv):
+    """Device half of the yuv420 wire: nearest 2× chroma upsample +
+    OpenCV's full-range BT.601 YCrCb→BGR affine, clipped to [0,255] so
+    downstream math sees uint8-canvas semantics.  Returns float32 BGR."""
+    import jax.numpy as jnp
+
+    yf = y.astype(jnp.float32)
+    uvf = uv.astype(jnp.float32)
+    uvf = jnp.repeat(jnp.repeat(uvf, 2, axis=-3), 2, axis=-2)
+    cr = uvf[..., 0] - 128.0
+    cb = uvf[..., 1] - 128.0
+    img = jnp.stack([yf + 1.773 * cb,                        # B
+                     yf - 0.714 * cr - 0.344 * cb,           # G
+                     yf + 1.403 * cr], axis=-1)              # R
+    return jnp.clip(img, 0.0, 255.0)
+
+
+class Yuv420Staging(FeatureTransformer):
+    """Serving-chain stage: convert the (already resized) uint8 BGR mat
+    to yuv420 wire planes, stored as ``feature["yuv_y"]`` /
+    ``feature["yuv_uv"]``.  Runs INSIDE the per-feature chain so
+    ``_maybe_parallel`` spreads the conversion across workers instead of
+    serializing it in the batcher."""
+
+    def transform_mat(self, feature: ImageFeature) -> None:
+        mat = feature.mat
+        if mat is None:
+            raise ValueError("Yuv420Staging needs a decoded mat")
+        if mat.dtype != np.uint8:
+            mat = np.clip(mat, 0, 255).astype(np.uint8)
+        y, uv = bgr_to_yuv420_host(mat)
+        feature["yuv_y"] = y
+        feature["yuv_uv"] = uv
+
+
 class DeviceAugPrepare(FeatureTransformer):
     """Host half: decode → geometry/labels → staging tensors.
 
@@ -189,17 +238,13 @@ class DeviceAugPrepare(FeatureTransformer):
                      if rr() < p.hue_prob else 0.0)
 
         if p.wire_format == "yuv420":
-            import cv2
-
             S = p.canvas_size
-            ycrcb = cv2.cvtColor(mat, cv2.COLOR_BGR2YCrCb)
+            yp, chroma = bgr_to_yuv420_host(mat)
             ch, cw = (h + 1) // 2, (w + 1) // 2
-            chroma = cv2.resize(ycrcb[:, :, 1:], (cw, ch),
-                                interpolation=cv2.INTER_AREA)
             y_canvas = np.zeros((S, S), np.uint8)
-            y_canvas[:h, :w] = ycrcb[:, :, 0]
+            y_canvas[:h, :w] = yp
             uv_canvas = np.zeros((S // 2, S // 2, 2), np.uint8)
-            uv_canvas[:ch, :cw] = chroma.reshape(ch, cw, 2)
+            uv_canvas[:ch, :cw] = chroma
             staged = {"y": y_canvas, "uv": uv_canvas}
         else:
             canvas = np.zeros((p.canvas_size, p.canvas_size, 3), np.uint8)
@@ -399,19 +444,7 @@ def make_device_augment(param: DeviceAugParam, compute_dtype=None):
         return finish(canvas.astype(jnp.float32), rect, size, flip, jitter)
 
     def one_yuv(y, uv, rect, size, flip, jitter):
-        # Reconstruct the uint8 BGR canvas on-device: nearest 2× chroma
-        # upsample + OpenCV's full-range BT.601 YCrCb→BGR affine, clipped
-        # to [0,255] to keep uint8-canvas semantics for the jitter math.
-        yf = y.astype(jnp.float32)
-        uvf = uv.astype(jnp.float32)
-        uvf = jnp.repeat(jnp.repeat(uvf, 2, axis=0), 2, axis=1)
-        cr = uvf[..., 0] - 128.0
-        cb = uvf[..., 1] - 128.0
-        img = jnp.stack([yf + 1.773 * cb,                    # B
-                         yf - 0.714 * cr - 0.344 * cb,       # G
-                         yf + 1.403 * cr], axis=-1)          # R
-        img = jnp.clip(img, 0.0, 255.0)
-        return finish(img, rect, size, flip, jitter)
+        return finish(yuv420_to_bgr_device(y, uv), rect, size, flip, jitter)
 
     vone = jax.vmap(one_yuv if yuv else one_bgr)
 

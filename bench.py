@@ -223,7 +223,8 @@ def bench_ssd_serve(args, mesh, records):
     model = Model(SSDVgg(num_classes=args.classes, resolution=res))
     model.build(0, jnp.zeros((1, res, res, 3), jnp.float32))
     param = PreProcessParam(batch_size=args.batch, resolution=res,
-                            num_workers=args.workers)
+                            num_workers=args.workers,
+                            wire_format=args.wire_format)
     on_tpu = jax.default_backend() in ("tpu", "axon")
     predictor = SSDPredictor(
         model, param,
@@ -243,6 +244,7 @@ def bench_ssd_serve(args, mesh, records):
     _emit(f"ssd{args.res}_serve_images_per_sec_per_chip", per_chip,
           "images/sec/chip", None,
           nms_backend="pallas" if on_tpu else "xla",  # auto-resolved
+          batch=args.batch, wire_format=args.wire_format,
           note="decode+preprocess+forward+DetectionOutput+rescale; "
                "no published reference anchor")
 
