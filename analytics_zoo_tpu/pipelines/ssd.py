@@ -100,6 +100,10 @@ class PreProcessParam:
     # staged-pixel wire format for the device-aug path ("bgr" | "yuv420");
     # see DeviceAugParam.wire_format — "yuv420" halves host→device bytes
     wire_format: str = "bgr"
+    # pack the device-aug staged batch into one (B, item_bytes) uint8
+    # transfer (DeviceAugParam.pack): wins when per-transfer latency,
+    # not bandwidth, bounds the input link
+    pack_staging: bool = False
 
 
 class RecordToFeature(Transformer):
@@ -233,7 +237,8 @@ def load_train_set_device(pattern: str, param: PreProcessParam,
                  if param.canvas_size else {})
         aug = DeviceAugParam(resolution=param.resolution,
                              pixel_means=tuple(param.pixel_means),
-                             wire_format=param.wire_format, **extra)
+                             wire_format=param.wire_format,
+                             pack=param.pack_staging, **extra)
     chain = (RecordToFeature() >> BytesToMat(to_float=False) >> RoiNormalize()
              >> DeviceAugPrepare(aug))
     ds = DataSet.from_record_files(pattern, SSDByteRecord.decode,
@@ -241,7 +246,8 @@ def load_train_set_device(pattern: str, param: PreProcessParam,
     if param.shuffle_buffer:
         ds = ds.shuffle(param.shuffle_buffer, seed=param.shuffle_seed)
     ds = (ds.transform(_maybe_parallel(chain, param.num_workers))
-          .transform(DeviceAugBatch(param.batch_size, param.max_gt)))
+          .transform(DeviceAugBatch(param.batch_size, param.max_gt,
+                                    pack=aug.pack)))
     return ds, make_device_augment(aug)
 
 

@@ -64,6 +64,16 @@ class DeviceAugParam:
     # the lever when the input link (PCIe, or a tunneled relay) — not
     # host CPU — bounds end-to-end training throughput.
     wire_format: str = "bgr"
+    # Pack the whole staged batch into ONE (B, item_bytes) uint8 array:
+    # a single host→device transfer per batch instead of ~11 per-leaf
+    # transfers.  On high-latency links (tunneled relay; congested PCIe)
+    # per-transfer overhead — not bandwidth — can dominate the input
+    # path; measured on the relay: yuv420 packed moves the same bytes
+    # ~1.5× faster than yuv420 unpacked.  The device program unpacks by
+    # slice + bitcast inside the fused augmentation, so nothing else in
+    # the step changes.  Row-major (B first) keeps data-parallel dim-0
+    # sharding working unchanged.
+    pack: bool = False
 
     def __post_init__(self):
         # fail fast: inside the pipeline these would be caught by the
@@ -262,16 +272,46 @@ class DeviceAugPrepare(FeatureTransformer):
         }
 
 
+def packed_layout(canvas_size: int, wire_format: str, max_gt: int):
+    """Single source of truth for the packed-staging row layout:
+    ``[(key, dtype, per-image shape)]`` in byte order.  The host packer
+    (``DeviceAugBatch``) and the device unpacker (``make_device_augment``)
+    both iterate this list, so they cannot drift apart."""
+    S = canvas_size
+    if wire_format == "yuv420":
+        pixels = [("y", np.uint8, (S, S)),
+                  ("uv", np.uint8, (S // 2, S // 2, 2))]
+    else:
+        pixels = [("canvas", np.uint8, (S, S, 3))]
+    return pixels + [
+        ("rect", np.float32, (4,)),
+        ("size", np.float32, (2,)),
+        ("flip", np.float32, ()),
+        ("jitter", np.float32, (5,)),
+        ("im_info", np.float32, (4,)),
+        ("bboxes", np.float32, (max_gt, 4)),
+        ("labels", np.int32, (max_gt,)),
+        ("difficult", np.float32, (max_gt,)),
+        ("mask", np.float32, (max_gt,)),
+    ]
+
+
 class DeviceAugBatch(FeatureTransformer):
     """Collate DeviceAugPrepare dicts into a device-ready batch: the
-    ``RoiImageToBatch`` counterpart for the device-augmentation path."""
+    ``RoiImageToBatch`` counterpart for the device-augmentation path.
+
+    ``pack=True`` emits ``{"packed": (B, item_bytes) uint8}`` instead of
+    the ~11-leaf dict (see ``DeviceAugParam.pack``); field order and
+    dtypes come from ``packed_layout``, shapes from the collated arrays
+    themselves, so no extra configuration can drift from the unpacker."""
 
     def __init__(self, batch_size: int, max_gt: int = 100,
-                 drop_remainder: bool = True):
+                 drop_remainder: bool = True, pack: bool = False):
         super().__init__()
         self.batch_size = batch_size
         self.max_gt = max_gt
         self.drop_remainder = drop_remainder
+        self.pack = pack
 
     def apply_iter(self, it):
         buf: List[Dict] = []
@@ -302,7 +342,7 @@ class DeviceAugBatch(FeatureTransformer):
             "flip": np.stack([d["flip"] for d in ds]),
             "jitter": np.stack([d["jitter"] for d in ds]),
         })
-        return {
+        batch = {
             "aug": aug,
             "im_info": np.stack([d["im_info"] for d in ds]),
             "target": {
@@ -310,6 +350,24 @@ class DeviceAugBatch(FeatureTransformer):
                 "difficult": dd[..., 0], "mask": mask,
             },
         }
+        if not self.pack:
+            return batch
+        flat_src = {**aug, "im_info": batch["im_info"], **batch["target"]}
+        B = flat_src["rect"].shape[0]
+        # key order + dtypes from packed_layout (the unpacker's source of
+        # truth; sizes there are irrelevant for ordering), shapes from
+        # the arrays; fill a preallocated row buffer — one host copy
+        fields = [(flat_src[key], np.dtype(dtype))
+                  for key, dtype, _ in packed_layout(
+                      2, "yuv420" if "y" in flat_src else "bgr", 1)]
+        views = [np.ascontiguousarray(a.astype(dt, copy=False))
+                 .reshape(B, -1).view(np.uint8) for a, dt in fields]
+        packed = np.empty((B, sum(v.shape[1] for v in views)), np.uint8)
+        off = 0
+        for v in views:
+            packed[:, off:off + v.shape[1]] = v
+            off += v.shape[1]
+        return {"packed": packed}
 
 
 # ---------------------------------------------------------------------------
@@ -448,8 +506,59 @@ def make_device_augment(param: DeviceAugParam, compute_dtype=None):
 
     vone = jax.vmap(one_yuv if yuv else one_bgr)
 
+    def unpack(arr):
+        """(B, item_bytes) uint8 → the staged batch dict, by slice +
+        bitcast against the shared ``packed_layout``.  max_gt is solved
+        from the row size (every non-gt field's extent is fixed by the
+        canvas), so the unpacker needs no extra configuration."""
+        from jax import lax
+
+        B, item = arr.shape
+        S = param.canvas_size
+
+        def row_bytes(layout):
+            # np.prod(()) == 1 handles the scalar field; (0, ...) shapes
+            # correctly contribute zero bytes
+            return sum(int(np.prod(shape, dtype=np.int64))
+                       * np.dtype(dtype).itemsize
+                       for _, dtype, shape in layout)
+
+        # solve max_gt from the row size using the layout itself (no
+        # duplicated byte constants to drift from packed_layout)
+        base = row_bytes(packed_layout(S, param.wire_format, 0))
+        per_gt = row_bytes(packed_layout(S, param.wire_format, 1)) - base
+        rest = item - base
+        if rest < 0 or rest % per_gt:
+            raise ValueError(
+                f"packed row of {item} B doesn't fit canvas {S} "
+                f"({param.wire_format}): check the packer's layout")
+        layout = packed_layout(S, param.wire_format, rest // per_gt)
+        fields, off = {}, 0
+        for key, dtype, shape in layout:
+            n = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+            piece = arr[:, off:off + n]
+            off += n
+            if dtype is np.uint8:
+                fields[key] = piece.reshape((B,) + shape)
+            else:
+                tgt = jnp.float32 if dtype is np.float32 else jnp.int32
+                piece = lax.bitcast_convert_type(
+                    piece.reshape(B, n // 4, 4), tgt)
+                fields[key] = piece.reshape((B,) + shape)
+        pix = (("y", "uv") if yuv else ("canvas",))
+        return {
+            "aug": {k: fields[k] for k in
+                    pix + ("rect", "size", "flip", "jitter")},
+            "im_info": fields["im_info"],
+            "target": {k: fields[k] for k in
+                       ("bboxes", "labels", "difficult", "mask")},
+        }
+
     @jax.jit
     def augment(batch):
+        if "packed" in batch:
+            extra = {k: v for k, v in batch.items() if k != "packed"}
+            batch = {**unpack(batch["packed"]), **extra}
         aug = batch["aug"]
         out = dict(batch)
         out.pop("aug")

@@ -108,7 +108,8 @@ def bench_ssd_train(args, mesh, shard_pattern, device_aug: bool):
     param = PreProcessParam(batch_size=args.batch, resolution=res,
                             num_workers=args.workers, max_gt=8,
                             canvas_size=((res + 7) // 8) * 8,
-                            wire_format=args.wire_format)
+                            wire_format=args.wire_format,
+                            pack_staging=not args.no_pack)
     if device_aug:
         dataset, augment = load_train_set_device(shard_pattern, param)
     else:
@@ -385,6 +386,9 @@ def main() -> int:
                    default="yuv420",
                    help="staged-pixel host→device wire format for the "
                         "device-aug train phase (yuv420 = 1.5 B/px)")
+    p.add_argument("--no-pack", action="store_true",
+                   help="stage the train batch as ~11 separate arrays "
+                        "instead of one packed (B, item_bytes) transfer")
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--res", type=int, default=300)
     p.add_argument("--classes", type=int, default=21)
@@ -549,6 +553,7 @@ def main() -> int:
                    if args.res == 300 else None),
                   final_loss=round(float(loss), 3),
                   batch=args.batch, wire_format=args.wire_format,
+                  packed=not args.no_pack,
                   vs_round1_synthetic=(
                       round(per_chip / ROUND1_TRAIN_IMG_S, 3)
                       if args.res == 300 else None),

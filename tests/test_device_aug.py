@@ -8,6 +8,7 @@ and the end-to-end staging → jitted-augment batch path.
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from analytics_zoo_tpu.transform.vision.device import (
@@ -200,6 +201,56 @@ def test_yuv420_wire_parity_and_size():
     diff = np.abs(outs["yuv420"] - outs["bgr"])
     assert diff.mean() <= 4.0       # chroma decimation error only
     assert np.isfinite(outs["yuv420"]).all()
+
+
+@pytest.mark.parametrize("wire", ["bgr", "yuv420"])
+def test_packed_staging_bitwise_parity(wire):
+    """pack=True moves the SAME bytes in one (B, item_bytes) transfer;
+    the device unpacker must reproduce the unpacked path's augmented
+    batch BITWISE (both run the identical augment program after
+    unpacking — any diff means the layouts drifted)."""
+    import random
+
+    from analytics_zoo_tpu.data import generate_shapes_records, read_ssd_records
+    from analytics_zoo_tpu.pipelines.ssd import RecordToFeature
+    from analytics_zoo_tpu.transform.vision import BytesToMat, RoiNormalize
+
+    import os
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        paths = generate_shapes_records(os.path.join(tmp, "s"), n_images=8,
+                                        resolution=160, num_shards=1)
+        records = list(read_ssd_records(paths))
+
+    outs = {}
+    for pack in (False, True):
+        param = DeviceAugParam(resolution=96, canvas_size=192,
+                               wire_format=wire, pack=pack)
+        chain = (RecordToFeature() >> BytesToMat() >> RoiNormalize()
+                 >> DeviceAugPrepare(param)
+                 >> DeviceAugBatch(4, max_gt=8, pack=pack))
+        random.seed(7)              # identical geometry/jitter decisions
+        batches = list(chain(records))
+        assert batches
+        if pack:
+            (b,) = batches[:1]
+            assert set(b.keys()) == {"packed"}
+            assert b["packed"].dtype == np.uint8 and b["packed"].ndim == 2
+        out = make_device_augment(param)(batches[0])
+        outs[pack] = jax.tree_util.tree_map(np.asarray, out)
+
+    assert sorted(outs[True]) == sorted(outs[False])
+    # pixels: the packed program's extra unpack prefix can change XLA's
+    # float fusion on CPU (measured max 6e-5); the TPU backend is
+    # bitwise.  target/im_info pass through unpack untouched — exact.
+    np.testing.assert_allclose(outs[True]["input"], outs[False]["input"],
+                               atol=1e-3)
+    np.testing.assert_array_equal(outs[True]["im_info"],
+                                  outs[False]["im_info"])
+    for k in outs[True]["target"]:
+        np.testing.assert_array_equal(outs[True]["target"][k],
+                                      outs[False]["target"][k])
 
 
 def test_device_aug_pipeline_entry():
