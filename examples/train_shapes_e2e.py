@@ -39,6 +39,10 @@ def main():
     p.add_argument("--out", default=None, help="append a report to this md file")
     p.add_argument("--target-map", type=float, default=0.9,
                    help="stop once validation mAP reaches this")
+    p.add_argument("--wire-format", choices=("bgr", "yuv420"),
+                   default="bgr", help="device-aug staging wire format")
+    p.add_argument("--pack", action="store_true",
+                   help="pack the staged batch into one transfer")
     p.add_argument("--host-aug", action="store_true",
                    help="use the reference-style host OpenCV chain instead "
                         "of device-side augmentation")
@@ -73,7 +77,9 @@ def main():
                                 seed=1)
         pre = PreProcessParam(batch_size=args.batch_size,
                               resolution=args.resolution,
-                              num_workers=args.workers, max_gt=8)
+                              num_workers=args.workers, max_gt=8,
+                              wire_format=args.wire_format,
+                              pack_staging=args.pack)
         augment = None
         if args.host_aug:
             train_set = load_train_set(os.path.join(tmp, "train-*.azr"), pre)
