@@ -105,6 +105,14 @@ class PreProcessParam:
     # not bandwidth, bounds the input link
     pack_staging: bool = False
 
+    def __post_init__(self):
+        # fail fast on the serving path too — a typo'd wire_format would
+        # otherwise silently fall through to the 3 B/px bgr wire (the
+        # train path already validates via DeviceAugParam.__post_init__)
+        if self.wire_format not in ("bgr", "yuv420"):
+            raise ValueError(f"unknown wire_format {self.wire_format!r}; "
+                             "expected 'bgr' or 'yuv420'")
+
 
 class RecordToFeature(Transformer):
     """SSDByteRecord → ImageFeature (reference ``RecordToFeature.scala:28``)."""

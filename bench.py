@@ -250,20 +250,40 @@ def bench_ssd_serve(args, mesh, records):
                "no published reference anchor")
 
     # int8 weight-only serving (utils.quantize): same pipeline, ~4x
-    # smaller params in HBM; vs_baseline = speed vs the fp32/bf16 path.
-    # Build the quantized predictor (it snapshots int8 weights), then
-    # release the fp32 predictor + executable so the measurement runs in
-    # the int8-only memory configuration the feature advertises.
+    # smaller params in HBM; both predictors stay live so their windows
+    # can interleave (SSD-VGG fp32+int8 together is ~125 MB — nowhere
+    # near HBM pressure; the 4x artifact-size claim is pinned separately
+    # by tests/test_quantize.py).
     q_predictor = SSDPredictor(
         model, param,
         post=DetectionOutputParam(n_classes=args.classes, backend="auto"),
         compute_dtype=args.compute_dtype, quantize=True)
-    del predictor
-    per_chip_q = _time_predict(q_predictor)
+    # int8-vs-fp ratio from INTERLEAVED windows: a sequential pair would
+    # charge the second predictor the post-ratchet degraded link (one
+    # run recorded int8 "0.81×" purely from ordering).  The order also
+    # ALTERNATES per round — on a monotonically-degrading link a fixed
+    # fp-then-int8 order would still bias every int8 window onto an
+    # equal-or-worse link state — and the reported ratio is the median
+    # of PER-PAIR ratios, which cancels the common drift within a pair.
+    fp_rates, q_rates, ratios = [], [], []
+    for i in range(3):
+        pair = ((predictor, q_predictor) if i % 2 == 0
+                else (q_predictor, predictor))
+        a = _time_predict(pair[0])
+        b = _time_predict(pair[1])
+        fp, q = (a, b) if i % 2 == 0 else (b, a)
+        fp_rates.append(fp)
+        q_rates.append(q)
+        ratios.append(q / max(fp, 1e-9))
+    med = lambda xs: sorted(xs)[len(xs) // 2]            # odd count
+    per_chip_q = med(q_rates)
     return _emit(f"ssd{args.res}_serve_int8_images_per_sec_per_chip", per_chip_q,
-                 "images/sec/chip", per_chip_q / max(per_chip, 1e-9),
+                 "images/sec/chip", med(ratios),
+                 fp_windows=[round(x, 2) for x in fp_rates],
+                 int8_windows=[round(x, 2) for x in q_rates],
                  note="int8 weight-only quantized serving; vs_baseline = "
-                      "speedup vs the fp32/bf16 serving path above")
+                      "median of per-pair int8/fp ratios over interleaved "
+                      "windows with alternating order (drift-cancelling)")
 
 
 def bench_detection_output_backends(args):

@@ -42,6 +42,13 @@ def main():
                         "image, every one is cross-boundary-ignored, and "
                         "the RPN never gets a positive")
     p.add_argument("--out", default=None)
+    p.add_argument("--params-out", default="frcnn_shapes_params.msgpack",
+                   help="save trained variables here right after training "
+                        "(the tunneled relay can die at the eval compile "
+                        "— don't lose the run with it)")
+    p.add_argument("--eval-only", default=None, metavar="PARAMS_FILE",
+                   help="skip training; evaluate saved variables "
+                        "(shape-checked against the built model)")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -90,9 +97,15 @@ def main():
                     jnp.asarray([[args.res, args.res, 1.0]], jnp.float32))
 
         t0 = time.time()
-        train_frcnn(model, train_set, args.res, epochs=args.epochs,
-                    lr=args.lr)
-        wall = time.time() - t0
+        if args.eval_only:
+            model.load(args.eval_only)     # from_bytes shape-checks vs build
+            wall = 0.0
+        else:
+            train_frcnn(model, train_set, args.res, epochs=args.epochs,
+                        lr=args.lr)
+            wall = time.time() - t0
+            if args.params_out:
+                model.save(args.params_out)
 
         # eval: the serving assembly with the trained weights
         det = FasterRcnnDetector(

@@ -139,30 +139,35 @@ def test_device_aug_end_to_end():
 
 
 def test_yuv420_reconstruction_matches_cv2_roundtrip():
-    """Device YCrCb→BGR affine + nearest chroma upsample vs the exact
-    same decimation done by OpenCV on host: flat regions are ~exact,
-    a smooth gradient stays within interpolation error."""
+    """The PRODUCTION host packer (`bgr_to_yuv420_host`) + device
+    reconstructor (`yuv420_to_bgr_device`) round-trip: flat regions are
+    ~exact, a smooth gradient stays within chroma-interpolation error.
+    Also pins the device affine against OpenCV's own YCrCb→BGR on the
+    full-res (non-subsampled) planes, catching coefficient regressions
+    at the 1-LSB level."""
+    from analytics_zoo_tpu.transform.vision.device import (
+        bgr_to_yuv420_host, yuv420_to_bgr_device)
+
     rng = np.random.RandomState(4)
     flat = np.tile(rng.randint(0, 256, (1, 1, 3), np.uint8), (32, 32, 1))
     gx, gy = np.meshgrid(np.linspace(0, 255, 32), np.linspace(0, 255, 32))
     grad = np.stack([gx, gy, np.full((32, 32), 128.0)],
                     axis=-1).astype(np.uint8)
     for img, tol in ((flat, 3.0), (grad, 8.0)):
-        h, w = img.shape[:2]
-        param = DeviceAugParam(resolution=32, canvas_size=32,
-                               wire_format="yuv420")
-        prep = DeviceAugPrepare(param)
-        ycrcb = cv2.cvtColor(img, cv2.COLOR_BGR2YCrCb)
-        chroma = cv2.resize(ycrcb[:, :, 1:], (w // 2, h // 2),
-                            interpolation=cv2.INTER_AREA)
-        # device-side reconstruction (mirrors one_yuv's affine)
-        uvf = np.repeat(np.repeat(chroma.astype(np.float32), 2, 0), 2, 1)
-        cr, cb = uvf[..., 0] - 128.0, uvf[..., 1] - 128.0
-        yf = ycrcb[:, :, 0].astype(np.float32)
-        recon = np.clip(np.stack([yf + 1.773 * cb,
-                                  yf - 0.714 * cr - 0.344 * cb,
-                                  yf + 1.403 * cr], -1), 0, 255)
+        y, uv = bgr_to_yuv420_host(img)
+        recon = np.asarray(yuv420_to_bgr_device(jnp.asarray(y),
+                                                jnp.asarray(uv)))
         assert np.abs(recon - img.astype(np.float32)).mean() <= tol
+
+    # coefficient pin: feed FULL-RES chroma (every 2x2 block constant so
+    # the nearest upsample is exact) and compare against cv2's inverse
+    rnd = rng.randint(0, 256, (8, 8, 3), np.uint8)
+    ycrcb = np.repeat(np.repeat(rnd, 2, 0), 2, 1)          # (16,16,3)
+    recon = np.asarray(yuv420_to_bgr_device(
+        jnp.asarray(ycrcb[:, :, 0]),
+        jnp.asarray(ycrcb[::2, ::2, 1:].copy())))
+    ref = cv2.cvtColor(ycrcb, cv2.COLOR_YCrCb2BGR).astype(np.float32)
+    assert np.abs(recon - ref).max() <= 1.5
 
 
 def test_yuv420_wire_parity_and_size():
