@@ -38,6 +38,7 @@ from analytics_zoo_tpu.pipelines.ssd import (
     TrainParams,
     Validator,
     load_train_set,
+    load_train_set_device,
     load_val_set,
     train_ssd,
     train_transformer,

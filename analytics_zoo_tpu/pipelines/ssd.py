@@ -15,7 +15,7 @@ import dataclasses
 import functools
 import logging
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 import jax
@@ -643,9 +643,14 @@ class TrainParams:
 
 
 def train_ssd(train_set, val_set, params: TrainParams,
-              model: Optional[Model] = None, mesh=None) -> Model:
+              model: Optional[Model] = None, mesh=None,
+              device_transform: Optional[Callable] = None) -> Model:
     """The Train entry point's optimize() assembly (reference
-    ``Train.scala:150-252``)."""
+    ``Train.scala:150-252``).
+
+    ``device_transform``: the jitted augment returned by
+    ``load_train_set_device`` — fuses the on-device augmentation into
+    every compiled train step (pass the matching staged ``train_set``)."""
     mesh = mesh or create_mesh()
     cfg = (ssd300_config() if params.resolution == 300 else ssd512_config())
     priors, variances = build_priors(cfg)
@@ -663,7 +668,8 @@ def train_ssd(train_set, val_set, params: TrainParams,
         opt = (Optimizer(model, train_set, criterion, mesh=mesh,
                          skip_loss_above=50.0,
                          compute_dtype=params.compute_dtype,
-                         prefetch=params.prefetch)
+                         prefetch=params.prefetch,
+                         device_transform=device_transform)
                .set_optim_method(optim_method)
                .set_end_when(end_when))
         if val_set is not None:

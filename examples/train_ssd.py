@@ -37,17 +37,37 @@ def main():
                    help="host augmentation worker threads")
     p.add_argument("--prefetch", type=int, default=2,
                    help="device prefetch depth (0 = synchronous)")
+    p.add_argument("--device-aug", action="store_true",
+                   help="run the augmentation pixel work ON-DEVICE, "
+                        "fused into the train step (host does decode + "
+                        "geometry only — the TPU-first input path)")
+    p.add_argument("--wire-format", choices=("bgr", "yuv420"),
+                   default="bgr",
+                   help="device-aug staging wire (yuv420 = 1.5 B/px)")
+    p.add_argument("--pack", action="store_true",
+                   help="device-aug staging as ONE packed transfer "
+                        "per batch")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
 
     from analytics_zoo_tpu.pipelines import (
-        PreProcessParam, TrainParams, load_train_set, load_val_set, train_ssd)
+        PreProcessParam, TrainParams, load_train_set, load_train_set_device,
+        load_val_set, train_ssd)
 
     pre = PreProcessParam(batch_size=args.batch_size,
                           resolution=args.resolution,
                           num_workers=args.num_workers,
-                          shuffle_buffer=args.shuffle_buffer)
-    train_set = load_train_set(args.train_records, pre)
+                          shuffle_buffer=args.shuffle_buffer,
+                          wire_format=args.wire_format,
+                          pack_staging=args.pack)
+    augment = None
+    if args.device_aug:
+        train_set, augment = load_train_set_device(args.train_records, pre)
+    elif args.pack or args.wire_format != "bgr":
+        raise SystemExit("--wire-format/--pack only apply to the "
+                         "device-aug staging path; add --device-aug")
+    else:
+        train_set = load_train_set(args.train_records, pre)
     val_set = (load_val_set(args.val_records, pre)
                if args.val_records else None)
     params = TrainParams(
@@ -76,7 +96,8 @@ def main():
                      len(report["missing"]))
         model.load_weights(new_params)
 
-    train_ssd(train_set, val_set, params, model=model)
+    train_ssd(train_set, val_set, params, model=model,
+              device_transform=augment)
 
 
 if __name__ == "__main__":
