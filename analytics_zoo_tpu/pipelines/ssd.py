@@ -259,6 +259,20 @@ def load_train_set_device(pattern: str, param: PreProcessParam,
     return ds, make_device_augment(aug)
 
 
+def _warn_host_chain_ignores_wire(param: PreProcessParam, fn: str) -> None:
+    # The host-aug chains always ship plain bgr float batches; silently
+    # dropping a requested yuv420/packed wire would make callers believe
+    # they benched the thin wire (bench.py's hostaug phase did exactly
+    # that).  Mirror the FrcnnPredictor guard: loud, not fatal.
+    if param.wire_format != "bgr" or param.pack_staging:
+        import warnings
+        warnings.warn(
+            f"{fn}: wire_format={param.wire_format!r} / "
+            f"pack_staging={param.pack_staging} are device-aug options; the "
+            "host-aug chain ignores them (use load_train_set_device)",
+            stacklevel=3)
+
+
 def load_train_set(pattern: str, param: PreProcessParam,
                    augment: bool = True) -> DataSet:
     """``augment=False`` keeps the TRAINING conveniences (file shuffling,
@@ -268,6 +282,7 @@ def load_train_set(pattern: str, param: PreProcessParam,
     coarse relative to the image (e.g. Faster-RCNN at small
     resolutions) lose their objects below the feature grid under
     zoom-out augmentation."""
+    _warn_host_chain_ignores_wire(param, "load_train_set")
     ds = DataSet.from_record_files(pattern, SSDByteRecord.decode,
                                    shuffle_files=True)
     if param.shuffle_buffer:
@@ -279,6 +294,10 @@ def load_train_set(pattern: str, param: PreProcessParam,
 
 
 def load_val_set(pattern: str, param: PreProcessParam) -> DataSet:
+    # no wire guard here: device-aug training legitimately shares one
+    # PreProcessParam between load_train_set_device and this val loader
+    # (examples/train_ssd.py), and validation has no device-aug variant
+    # to redirect to
     return (DataSet.from_record_files(pattern, SSDByteRecord.decode)
             .transform(_maybe_parallel(val_transformer(param),
                                        param.num_workers))

@@ -253,7 +253,9 @@ class DeviceAugPrepare(FeatureTransformer):
             ch, cw = (h + 1) // 2, (w + 1) // 2
             y_canvas = np.zeros((S, S), np.uint8)
             y_canvas[:h, :w] = yp
-            uv_canvas = np.zeros((S // 2, S // 2, 2), np.uint8)
+            # neutral-chroma padding (128 ⇒ black), matching Uint8ToBatch's
+            # serving-path semantics; zero would reconstruct to bright green
+            uv_canvas = np.full((S // 2, S // 2, 2), 128, np.uint8)
             uv_canvas[:ch, :cw] = chroma
             staged = {"y": y_canvas, "uv": uv_canvas}
         else:

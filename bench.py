@@ -105,11 +105,14 @@ def bench_ssd_train(args, mesh, shard_pattern, device_aug: bool):
     # the yuv420 wire format halves the remaining bytes again (the
     # e2e path is input-link-bound, not host-CPU-bound — measured:
     # the host chain alone does ~700 img/s single-threaded)
+    # wire_format/pack_staging only exist on the device-aug path; the
+    # host chain would ignore (and now warns on) them, so pin bgr there
     param = PreProcessParam(batch_size=args.batch, resolution=res,
                             num_workers=args.workers, max_gt=8,
                             canvas_size=((res + 7) // 8) * 8,
-                            wire_format=args.wire_format,
-                            pack_staging=not args.no_pack)
+                            wire_format=(args.wire_format if device_aug
+                                         else "bgr"),
+                            pack_staging=device_aug and not args.no_pack)
     if device_aug:
         dataset, augment = load_train_set_device(shard_pattern, param)
     else:

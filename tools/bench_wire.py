@@ -102,8 +102,13 @@ def main() -> int:
     for wire in rigs:
         float(np.asarray(last[wire]))
 
+    # Rotate the rig order each window: on a monotonically drifting link a
+    # fixed order biases whichever config always runs later in the window
+    # (same reason bench.py's int8 comparison alternates order).
+    names = list(rigs)
     for w in range(args.windows):
-        for wire, rig in rigs.items():
+        for wire in names[w % len(names):] + names[:w % len(names)]:
+            rig = rigs[wire]
             t0 = time.perf_counter()
             for _ in range(args.steps):
                 rig["state"], m = rig["step"](rig["state"],
