@@ -451,6 +451,7 @@ class Optimizer:
         self.resume_path: Optional[str] = None
         self._resume_requested = False
         self.failure_detector = None
+        self.epoch_hook = None
         self._skip_batches = 0      # mid-epoch resume fast-forward
         self._iter_in_epoch = 0
 
@@ -486,6 +487,15 @@ class Optimizer:
         ``--model``/``--state`` snapshot restart (``Train.scala:161-163``)."""
         self.resume_path = path
         self._resume_requested = True
+        return self
+
+    def set_epoch_hook(self, fn) -> "Optimizer":
+        """``fn(loop, state)`` after each completed epoch (post
+        validation/checkpoint) — e.g. an mAP-trajectory probe that runs a
+        detector assembly the ``ValidationMethod`` protocol can't express.
+        ``state`` params are live device arrays; pass them straight into a
+        jitted eval to avoid a host round-trip."""
+        self.epoch_hook = fn
         return self
 
     def set_failure_detector(self, detector) -> "Optimizer":
@@ -595,6 +605,8 @@ class Optimizer:
             t_epoch, records = time.time(), 0
             self._maybe_validate(loop, state, eval_step)
             self._maybe_checkpoint(loop, state)
+            if self.epoch_hook is not None:
+                self.epoch_hook(loop, state)
         # write trained variables back into the model wrapper (local-
         # replica read: safe on a mesh spanning processes)
         host_state = mesh_lib.host_local_state(state)

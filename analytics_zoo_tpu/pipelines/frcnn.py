@@ -88,14 +88,17 @@ class FrcnnPredictor:
             return detector.apply(v, x, info)
 
         if quantize:
-            # int8 weight-only serving, like SSDPredictor(quantize=True):
-            # weights live int8 in HBM (~4× smaller), dequant is fused
-            # into the consuming convs/matmuls inside the jitted program
+            # int8 serving, like SSDPredictor(quantize=...): True/"weight"
+            # keeps weights int8 in HBM (~4× smaller) with dequant fused
+            # into the consuming convs; "int8" runs real int8×int8→int32
+            # convolutions with dynamic activation quantization
             from analytics_zoo_tpu.utils.quantize import (
                 make_quantized_forward, quantize_params)
 
             self.variables = quantize_params(variables)
-            self._fwd = make_quantized_forward(detector, apply_fn=apply_fn)
+            self._fwd = make_quantized_forward(
+                detector, apply_fn=apply_fn,
+                compute="int8" if quantize == "int8" else "dequant")
         else:
             self._fwd = jax.jit(apply_fn)
 
@@ -178,7 +181,8 @@ def frcnn_train_batches(dataset, resolution: int):
 
 def train_frcnn(model, dataset, resolution: int, epochs: int = 10,
                 lr: float = 1e-3, mesh=None, loss_param=None,
-                grad_clip_norm: Optional[float] = 10.0):
+                grad_clip_norm: Optional[float] = 10.0,
+                lr_schedule=None, epoch_hook=None):
     """End-to-end Faster-RCNN training — capability the REFERENCE DOES
     NOT HAVE (its proposal layer throws on backward,
     ``common/nn/Proposal.scala``; Faster-RCNN there is import-and-serve
@@ -211,7 +215,9 @@ def train_frcnn(model, dataset, resolution: int, epochs: int = 10,
     opt = (Optimizer(model, frcnn_train_batches(dataset, resolution),
                      criterion, mesh=mesh or create_mesh(),
                      forward_fn=forward_fn, grad_clip_norm=grad_clip_norm)
-           .set_optim_method(SGD(lr, momentum=0.9))
+           .set_optim_method(SGD(lr, momentum=0.9, schedule=lr_schedule))
            .set_end_when(Trigger.max_epoch(epochs)))
+    if epoch_hook is not None:
+        opt.set_epoch_hook(epoch_hook)
     opt.optimize()
     return model

