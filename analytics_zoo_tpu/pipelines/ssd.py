@@ -313,7 +313,11 @@ class SSDPredictor:
     def __init__(self, model: Model, param: PreProcessParam,
                  post: Optional[DetectionOutputParam] = None,
                  n_classes: int = 21, compute_dtype=None,
-                 quantize: bool = False):
+                 quantize=False):
+        """``quantize``: ``False`` (fp serving), ``True``/``"weight"``
+        (int8 weights in HBM, fp math — bandwidth compression), or
+        ``"int8"`` (real int8×int8→int32 convolutions on the MXU with
+        dynamic per-tensor activation quantization)."""
         self.model = model
         self.param = param
         self.post = post or DetectionOutputParam(n_classes=n_classes)
@@ -334,7 +338,8 @@ class SSDPredictor:
                 make_quantized_forward, quantize_params)
             self._variables = quantize_params(model.variables)
             self._eval_step = make_quantized_forward(
-                model.module, resolve_compute_dtype(compute_dtype))
+                model.module, resolve_compute_dtype(compute_dtype),
+                compute="int8" if quantize == "int8" else "dequant")
             self.model = None
         else:
             self._eval_step = make_eval_step(model.module,

@@ -216,6 +216,163 @@ class TestQuantizedPredictor:
             np.abs(ref).max() + 1e-6)
 
 
+class TestInt8Compute:
+    """compute="int8": real int8×int8→int32 matmuls/convs with dynamic
+    per-tensor activation quantization (VERDICT r3 item 2 — the
+    weight-only path compresses HBM but does fp math)."""
+
+    def test_dense_parity(self):
+        m = nn.Sequential([nn.Dense(256), nn.relu, nn.Dense(8)])
+        variables = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 64)))
+        x = jnp.asarray(np.random.RandomState(1).randn(4, 64), jnp.float32)
+        ref = np.asarray(m.apply(variables, x))
+        fwd = make_quantized_forward(m, compute="int8")
+        out = np.asarray(fwd(quantize_params(variables, min_size=1024), x))
+        # activation quant adds error on top of weight quant: looser bound
+        assert np.abs(out - ref).max() < 0.1 * (np.abs(ref).max() + 1e-6)
+
+    def test_conv_parity_all_geometries(self):
+        """Strided / padded / dilated / grouped convs all route through
+        the interceptor's lax.conv_general_dilated reconstruction."""
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = nn.Conv(32, (3, 3), strides=(2, 2), padding="SAME")(x)
+                x = nn.relu(x)
+                x = nn.Conv(32, (3, 3), padding=((1, 1), (1, 1)),
+                            kernel_dilation=(2, 2))(x)
+                x = nn.relu(x)
+                x = nn.Conv(32, (3, 3), padding=1, feature_group_count=2)(x)
+                return nn.Conv(8, (1, 1))(x)
+
+        m = Net()
+        x = jnp.asarray(np.random.RandomState(2).randn(2, 16, 16, 8),
+                        jnp.float32)
+        variables = m.init(jax.random.PRNGKey(0), x)
+        ref = np.asarray(m.apply(variables, x))
+        fwd = make_quantized_forward(m, compute="int8")
+        out = np.asarray(fwd(quantize_params(variables, min_size=256), x))
+        assert out.shape == ref.shape
+        assert np.abs(out - ref).max() < 0.15 * (np.abs(ref).max() + 1e-6)
+
+    def test_int8_math_is_exact_for_integer_weights(self):
+        """With integer-valued weights and activations in range, the int8
+        path must be bit-exact (q*scale reconstruction introduces no
+        float error beyond the rescale): proves the conv really runs on
+        integer values, not dequantized floats."""
+        from analytics_zoo_tpu.utils.quantize import int8_apply
+
+        m = nn.Conv(4, (3, 3), padding=1, use_bias=False)
+        rng = np.random.RandomState(3)
+        w = rng.randint(-126, 127, (3, 3, 2, 4)).astype(np.float32)
+        w[0, 0, 0, :] = 127          # per-channel amax exactly 127 →
+        x_np = rng.randint(-126, 127, (1, 8, 8, 2)).astype(np.float32)
+        x_np[0, 0, 0, 0] = 127       # → weight AND activation scales == 1
+        x = jnp.asarray(x_np)
+        variables = {"params": {"kernel": jnp.asarray(w)}}
+        ref = np.asarray(m.apply(variables, x))
+        q = quantize_params(variables, min_size=1)
+        out = np.asarray(int8_apply(m.apply, q, x))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-2)
+
+    def test_unselected_layers_stay_fp(self):
+        """Layers whose kernel is NOT a QTensor run the normal fp path —
+        mixed graphs work (quantize_params selectivity is honored)."""
+        m = nn.Sequential([nn.Dense(256), nn.relu, nn.Dense(8)])
+        variables = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 64)))
+        # only the big first kernel quantizes; Dense(8)'s 2048-element
+        # kernel stays fp under min_size=4096
+        q = quantize_params(variables, min_size=4096)
+        n_q = sum(isinstance(l, QTensor) for l in jax.tree_util.tree_leaves(
+            q, is_leaf=lambda x: isinstance(x, QTensor)))
+        assert n_q == 1
+        x = jnp.asarray(np.random.RandomState(4).randn(4, 64), jnp.float32)
+        ref = np.asarray(m.apply(variables, x))
+        out = np.asarray(make_quantized_forward(m, compute="int8")(q, x))
+        assert np.abs(out - ref).max() < 0.1 * (np.abs(ref).max() + 1e-6)
+
+    def test_ssd_predictor_int8_compute(self):
+        """SSDPredictor(quantize="int8") end-to-end on records: output
+        structure intact, scores close to fp on an untrained net."""
+        import cv2
+
+        from analytics_zoo_tpu.core.module import Model
+        from analytics_zoo_tpu.data import SSDByteRecord
+        from analytics_zoo_tpu.models import SSDVgg
+        from analytics_zoo_tpu.pipelines.ssd import (PreProcessParam,
+                                                     SSDPredictor)
+
+        rng = np.random.RandomState(6)
+        model = Model(SSDVgg(num_classes=4, resolution=300))
+        model.build(0, jnp.zeros((1, 300, 300, 3), jnp.float32))
+        recs = []
+        for i in range(2):
+            img = rng.randint(0, 255, (80, 60, 3), np.uint8)
+            _, buf = cv2.imencode(".jpg", img)
+            recs.append(SSDByteRecord(data=buf.tobytes(), path=f"{i}.jpg"))
+        param = PreProcessParam(batch_size=2, resolution=300)
+        base = SSDPredictor(model, param, n_classes=4).predict(recs)
+        quant = SSDPredictor(model, param, n_classes=4,
+                             quantize="int8").predict(recs)
+        assert len(base) == len(quant) == 2
+        for b, q in zip(base, quant):
+            assert b.shape == q.shape
+            np.testing.assert_allclose(q[:, 1], b[:, 1], atol=0.1)
+
+    def test_non_conv_dense_qtensors_fall_back_to_dequant(self):
+        """DEFAULT_PATTERN also quantizes nn.Embed's `embedding` (and
+        would catch RNN-cell kernels) — modules the interceptor can't
+        run in int8.  compute="int8" must dequantize those up front
+        (discovered by an abstract trace) instead of crashing."""
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, ids):
+                x = nn.Embed(64, 128, name="emb")(ids)
+                return nn.Dense(8, name="out")(x)
+
+        m = Net()
+        ids = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+        variables = m.init(jax.random.PRNGKey(0), ids)
+        q = quantize_params(variables, min_size=512)
+        kinds = {k for k in ("embedding", "kernel")
+                 for l in [q["params"]["emb" if k == "embedding" else "out"]]
+                 if isinstance(l.get(k), QTensor)}
+        assert kinds == {"embedding", "kernel"}   # BOTH got quantized
+        ref = np.asarray(m.apply(variables, ids))
+        out = np.asarray(make_quantized_forward(m, compute="int8")(q, ids))
+        assert np.abs(out - ref).max() < 0.1 * (np.abs(ref).max() + 1e-6)
+
+    def test_int8_conv1d_channel_last(self):
+        """1-D convs are channel-last in flax; the interceptor must NOT
+        fall into lax's channel-first default dimension numbers."""
+        m = nn.Conv(16, (5,), padding="SAME")
+        x = jnp.asarray(np.random.RandomState(8).randn(2, 32, 8),
+                        jnp.float32)
+        variables = m.init(jax.random.PRNGKey(0), x)
+        ref = np.asarray(m.apply(variables, x))
+        q = quantize_params(variables, min_size=256)
+        from analytics_zoo_tpu.utils.quantize import int8_apply
+        out = np.asarray(int8_apply(m.apply, q, x))
+        assert out.shape == ref.shape
+        assert np.abs(out - ref).max() < 0.1 * (np.abs(ref).max() + 1e-6)
+
+    def test_bf16_mixed_int8(self):
+        """compute="int8" with bf16 remainder: QTensor scales must stay
+        fp32 (accuracy-critical rescale) while unselected layers cast."""
+        m = nn.Sequential([nn.Dense(256), nn.relu, nn.Dense(8)])
+        variables = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 64)))
+        q = quantize_params(variables, min_size=1024)
+        fwd = make_quantized_forward(m, jnp.bfloat16, compute="int8")
+        x = jnp.asarray(np.random.RandomState(7).randn(4, 64), jnp.float32)
+        out = fwd(q, x)
+        assert out.dtype == jnp.float32
+        ref = np.asarray(m.apply(variables, x))
+        assert np.abs(np.asarray(out) - ref).max() < 0.15 * (
+            np.abs(ref).max() + 1e-6)
+
+
 class TestServingArtifact:
     def test_npz_roundtrip(self, tmp_path):
         from analytics_zoo_tpu.utils.quantize import (load_quantized_npz,
