@@ -50,9 +50,12 @@ from analytics_zoo_tpu.parallel.expert import (
     route_top1,
 )
 from analytics_zoo_tpu.parallel.pipeline import (
+    carrier_decay_mask,
     flatten_stage_params,
+    flatten_stage_params_grouped,
     pipeline_forward,
     pipeline_forward_het,
+    stage_carrier_slice,
     unflatten_stage,
     split_microbatches,
     stack_stage_params,
