@@ -61,13 +61,32 @@ def flops_of(jitted, *args):
         return 0.0
 
 
+def cost_of(jitted, *args):
+    """(flops, bytes_accessed) from a jitted fn's compiled cost analysis."""
+    try:
+        ca = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return (float(ca.get("flops", 0.0)),
+                float(ca.get("bytes accessed", 0.0)))
+    except Exception:
+        return 0.0, 0.0
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--batches", type=int, nargs="+", default=[32, 48, 64])
     p.add_argument("--res", type=int, default=300)
     p.add_argument("--iters", type=int, default=10)
-    p.add_argument("--out", default="MFU_PROFILE.json")
+    p.add_argument("--ceiling", action="store_true",
+                   help="MFU-ceiling decomposition (VERDICT r3 item 10): "
+                        "scoped programs + a roofline estimate naming the "
+                        "residual non-MXU time; writes --out "
+                        "(default MFU_CEILING.json)")
+    p.add_argument("--out", default=None)
     args = p.parse_args()
+    if args.out is None:
+        args.out = "MFU_CEILING.json" if args.ceiling else "MFU_PROFILE.json"
 
     global jax
     import numpy as np
@@ -112,6 +131,93 @@ def main() -> int:
     # state buffers, and model.variables aliases them — later rebuilds
     # would hand deleted arrays to device_put
     host_state0 = jax.device_get(create_train_state(model, optim))
+
+    if args.ceiling:
+        # advertised HBM bandwidth per chip (GB/s)
+        hbm_bw = {"TPU v5 lite": 819.0, "TPU v5e": 819.0,
+                  "TPU v4": 1228.0, "TPU v5p": 2765.0,
+                  "TPU v6 lite": 1640.0}.get(kind)
+        B = args.batches[0]
+        batch = make_batch(B)
+        state = replicate(host_state0, mesh)
+        params_bf16 = cast_floating(state.params, jnp.bfloat16)
+        x_bf16 = batch["input"].astype(jnp.bfloat16)
+        tgt = batch["target"]
+
+        def fwd(p, x):
+            return model.module.apply(
+                {"params": p}, x, train=True,
+                rngs={"dropout": jax.random.PRNGKey(0)},
+                mutable=["batch_stats"])[0]
+
+        def loss_mb(p, x, t):
+            out = fwd(p, x)
+            out = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.float32), out)
+            return criterion(out, t)
+
+        def loss_sum(p, x):
+            loc, conf = fwd(p, x)
+            return (loc.astype(jnp.float32).sum()
+                    + conf.astype(jnp.float32).sum())
+
+        step = make_train_step(model.module, criterion, optim, mesh=mesh,
+                               compute_dtype="bf16")
+        jg_mb = jax.jit(jax.grad(loss_mb))
+        jg_sum = jax.jit(jax.grad(loss_sum))
+
+        st = replicate(host_state0, mesh)
+        st, m = step(st, batch, 1.0)
+        jax.block_until_ready(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            st, m = step(st, batch, 1.0)
+        float(np.asarray(m["loss"]))
+        t_step = (time.perf_counter() - t0) / args.iters
+        t_gmb = timed(jg_mb, params_bf16, x_bf16, tgt, iters=args.iters)
+        t_gsum = timed(jg_sum, params_bf16, x_bf16, iters=args.iters)
+
+        f_step, by_step = cost_of(step, st, batch, 1.0)
+        f_gmb, by_gmb = cost_of(jg_mb, params_bf16, x_bf16, tgt)
+        f_gsum, by_gsum = cost_of(jg_sum, params_bf16, x_bf16)
+
+        tf_step = f_step / t_step / 1e12
+        # roofline: compute-time floor vs HBM-traffic floor for the SAME
+        # compiled program (XLA's own flops + bytes-accessed accounting)
+        t_compute_floor = f_step / (peak * 1e12) if peak else None
+        t_memory_floor = by_step / (hbm_bw * 1e9) if hbm_bw else None
+        roofline = (max(t_compute_floor, t_memory_floor)
+                    if t_compute_floor and t_memory_floor else None)
+        report = {
+            "device_kind": kind, "peak_bf16_tflops": peak,
+            "hbm_gb_per_sec": hbm_bw, "resolution": args.res, "batch": B,
+            "full_step_ms": round(t_step * 1e3, 2),
+            "fwd_bwd_multibox_ms": round(t_gmb * 1e3, 2),
+            "fwd_bwd_trivial_loss_ms": round(t_gsum * 1e3, 2),
+            "multibox_loss_cost_ms": round((t_gmb - t_gsum) * 1e3, 2),
+            "sgd_update_and_cast_cost_ms": round((t_step - t_gmb) * 1e3, 2),
+            "step_gflops": round(f_step / 1e9, 1),
+            "step_gbytes_accessed": round(by_step / 1e9, 2),
+            "arithmetic_intensity_flops_per_byte": round(f_step / by_step, 1)
+            if by_step else None,
+            "measured_tflops": round(tf_step, 2),
+            "measured_mfu": round(tf_step / peak, 4) if peak else None,
+            "roofline_floor_ms": round(roofline * 1e3, 2) if roofline else None,
+            "roofline_mfu_bound": (
+                round(t_compute_floor / roofline, 4) if roofline else None),
+            "bound_by": (None if roofline is None else
+                         "memory" if roofline == t_memory_floor
+                         else "compute"),
+            "grads_trivial_vs_multibox": {
+                "flops_gflops": [round(f_gsum / 1e9, 1),
+                                 round(f_gmb / 1e9, 1)],
+                "bytes_gb": [round(by_gsum / 1e9, 2), round(by_gmb / 1e9, 2)],
+            },
+        }
+        print(json.dumps(report))
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+        return 0
 
     # ---- stage breakdown at the first batch size ----
     B = args.batches[0]
