@@ -1,0 +1,282 @@
+"""Real-data readiness drill (VERDICT r3 item 9).
+
+The environment has no egress, so the real Pascal-VOC tarballs and the
+released ``VGG_VOC0712_SSD_300x300.caffemodel`` can't be staged — but if
+the driver ever provides them, ingestion must work with ZERO code
+changes.  These tests prove that against synthetic fixtures that mimic
+the exact on-disk layouts:
+
+* a ``VOCdevkit/VOC2007`` tree (JPEGImages / Annotations XML /
+  ImageSets/Main) rendered from the shapes generator but labeled with
+  real VOC class names, pushed through the ACTUAL
+  ``tools/get_pascal.py`` CLI → ``.azr`` shards → canonical train chain
+  → train steps → VOC07 mAP evaluation;
+* the reference's Hadoop SequenceFile container round-tripped through
+  the ACTUAL ``tools/seqfile_to_azr.py`` CLI;
+* a complete fake ``.caffemodel`` byte stream (protowire-serialized V2
+  NetParameter with a blob-carrying layer for EVERY SSDVgg parameter in
+  Caffe's OIHW layouts and Caffe-SSD names) read back through
+  ``utils.caffe.load_ssd_vgg_caffe`` with nothing missing and nothing
+  unused.
+
+Reference scripts being mirrored: ``pipeline/ssd/data/pascal/*`` and
+``ssd/example/Train.scala:170`` (pretrained caffemodel load).
+"""
+
+import os
+import subprocess
+import sys
+import xml.etree.ElementTree as ET
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# shapes class id → a real VOC class name (the fixture must exercise the
+# real 20-class vocabulary path, not the shapes one)
+VOC_NAME_FOR_SHAPE = {1: "aeroplane", 2: "bicycle", 3: "bird"}
+
+
+def _write_voc_fixture(root: str, ids, seed: int, res: int = 160):
+    """Render shapes images into the exact VOCdevkit on-disk layout."""
+    import cv2
+
+    from analytics_zoo_tpu.data.synthetic import render_shapes_image
+
+    voc = os.path.join(root, "VOC2007")
+    for d in ("JPEGImages", "Annotations",
+              os.path.join("ImageSets", "Main")):
+        os.makedirs(os.path.join(voc, d), exist_ok=True)
+    rng = np.random.RandomState(seed)
+    for img_id in ids:
+        img, gt = render_shapes_image(rng, resolution=res)
+        cv2.imwrite(os.path.join(voc, "JPEGImages", f"{img_id}.jpg"), img)
+        ann = ET.Element("annotation")
+        ET.SubElement(ann, "filename").text = f"{img_id}.jpg"
+        size = ET.SubElement(ann, "size")
+        ET.SubElement(size, "width").text = str(res)
+        ET.SubElement(size, "height").text = str(res)
+        ET.SubElement(size, "depth").text = "3"
+        for cls, diff, x1, y1, x2, y2 in gt:
+            obj = ET.SubElement(ann, "object")
+            ET.SubElement(obj, "name").text = VOC_NAME_FOR_SHAPE[int(cls)]
+            ET.SubElement(obj, "difficult").text = str(int(diff))
+            bb = ET.SubElement(obj, "bndbox")
+            ET.SubElement(bb, "xmin").text = str(float(x1))
+            ET.SubElement(bb, "ymin").text = str(float(y1))
+            ET.SubElement(bb, "xmax").text = str(float(x2))
+            ET.SubElement(bb, "ymax").text = str(float(y2))
+        ET.ElementTree(ann).write(
+            os.path.join(voc, "Annotations", f"{img_id}.xml"))
+    return voc
+
+
+def _write_imageset(voc: str, name: str, ids):
+    with open(os.path.join(voc, "ImageSets", "Main", f"{name}.txt"),
+              "w") as f:
+        f.write("\n".join(ids) + "\n")
+
+
+def _cli(script, *argv):
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO)
+    r = subprocess.run([sys.executable, os.path.join(REPO, script),
+                        *map(str, argv)],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, f"{script} failed:\n{r.stdout}\n{r.stderr}"
+    return r.stdout
+
+
+class TestVocDevkitDrill:
+    def test_devkit_to_shards_to_train_to_map(self, tmp_path):
+        """Staged VOCdevkit → `tools/get_pascal.py` CLI → shards →
+        canonical train chain → train steps → VOC07 mAP eval, zero code
+        changes anywhere along the path."""
+        from analytics_zoo_tpu.core.module import Model
+        from analytics_zoo_tpu.data import read_ssd_records
+        from analytics_zoo_tpu.models import (SSDAlexNet,
+                                              alexnet_ssd_config,
+                                              build_priors)
+        from analytics_zoo_tpu.ops import (DetectionOutputParam,
+                                           MultiBoxLoss, MultiBoxLossParam,
+                                           detection_output)
+        from analytics_zoo_tpu.parallel import (SGD, create_mesh,
+                                                create_train_state,
+                                                make_train_step, replicate)
+        from analytics_zoo_tpu.pipelines.evaluation import \
+            MeanAveragePrecision
+        from analytics_zoo_tpu.pipelines.ssd import (PreProcessParam,
+                                                     load_train_set,
+                                                     load_val_set)
+        from analytics_zoo_tpu.pipelines.voc import VOC_CLASSES
+
+        devkit = str(tmp_path / "VOCdevkit")
+        train_ids = [f"{i:06d}" for i in range(16)]
+        test_ids = [f"{i:06d}" for i in range(16, 24)]
+        voc = _write_voc_fixture(devkit, train_ids + test_ids, seed=0)
+        _write_imageset(voc, "trainval", train_ids)
+        _write_imageset(voc, "test", test_ids)
+
+        out = str(tmp_path / "azr" / "voc")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        log = _cli("tools/get_pascal.py", "--devkit", devkit, "-o", out,
+                   "--sets", "voc_2007_trainval,voc_2007_test", "-p", "2")
+        assert "voc_2007_trainval: 16 records" in log, log
+        assert "voc_2007_test: 8 records" in log, log
+
+        # records round-trip with real VOC class ids
+        recs = list(read_ssd_records(
+            [f"{out}-voc_2007_trainval-{i:05d}-of-00002.azr"
+             for i in range(2)]))
+        assert len(recs) == 16
+        cls_ids = {int(c) for r in recs if r.gt is not None
+                   for c in r.gt[:, 0]}
+        assert cls_ids <= {VOC_CLASSES.index(n)
+                           for n in VOC_NAME_FOR_SHAPE.values()}
+
+        # canonical train chain → a few real train steps
+        mesh = create_mesh()
+        param = PreProcessParam(batch_size=8, resolution=300,
+                                num_workers=0, max_gt=8)
+        train_set = load_train_set(f"{out}-voc_2007_trainval-*.azr", param)
+        model = Model(SSDAlexNet(num_classes=len(VOC_CLASSES)))
+        model.build(0, jnp.zeros((1, 300, 300, 3), jnp.float32))
+        cfg = alexnet_ssd_config()
+        priors, variances = build_priors(cfg)
+        criterion = MultiBoxLoss(priors, variances,
+                                 MultiBoxLossParam(
+                                     n_classes=len(VOC_CLASSES)))
+        optim = SGD(1e-3, momentum=0.9)
+        state = replicate(create_train_state(model, optim), mesh)
+        step = make_train_step(model.module, criterion, optim, mesh=mesh)
+        from analytics_zoo_tpu.parallel import mesh as mesh_lib
+
+        losses = []
+        it = iter(train_set)
+        for _ in range(2):
+            state, m = step(state, mesh_lib.shard_batch(next(it), mesh), 1.0)
+            losses.append(float(np.asarray(m["loss"])))
+        assert all(np.isfinite(l) for l in losses), losses
+
+        # eval: forward + in-graph DetectionOutput → VOC07 mAP monoid
+        post = DetectionOutputParam(n_classes=len(VOC_CLASSES))
+        pr, va = jnp.asarray(priors), jnp.asarray(variances)
+
+        @jax.jit
+        def detect(variables, x):
+            loc, conf = model.module.apply(variables, x)
+            return detection_output(loc, jax.nn.softmax(conf, -1),
+                                    pr, va, post)
+
+        variables = {"params": jax.device_get(state.params)}
+        evaluator = MeanAveragePrecision(n_classes=len(VOC_CLASSES),
+                                         class_names=list(VOC_CLASSES))
+        total = None
+        for batch in load_val_set(f"{out}-voc_2007_test-*.azr", param):
+            dets = np.asarray(detect(variables,
+                                     jnp.asarray(batch["input"])))
+            r = evaluator(dets, batch)
+            total = r if total is None else total + r
+        m = float(total.result())
+        assert 0.0 <= m <= 1.0          # untrained net: the PATH is the claim
+
+    def test_seqfile_roundtrip_cli(self, tmp_path):
+        """Reference-era SequenceFile → `tools/seqfile_to_azr.py` CLI →
+        shards: record-for-record equality."""
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import seqfile_to_azr as sq
+
+        from analytics_zoo_tpu.data import read_ssd_records
+        from analytics_zoo_tpu.data.synthetic import (
+            _jpeg_encode, render_shapes_image)
+        from analytics_zoo_tpu.data.records import SSDByteRecord
+
+        rng = np.random.RandomState(1)
+        recs = []
+        for i in range(6):
+            img, gt = render_shapes_image(rng, resolution=96)
+            recs.append(SSDByteRecord(data=_jpeg_encode(img),
+                                      path=f"img{i}.jpg", gt=gt))
+        seq = str(tmp_path / "part-00000")
+        sq.write_sequence_file(seq, [sq.encode_reference_record(r)
+                                     for r in recs])
+        out = str(tmp_path / "conv")
+        _cli("tools/seqfile_to_azr.py", seq, "-o", out, "-p", "2")
+        back = list(read_ssd_records(sorted(
+            str(p) for p in tmp_path.glob("conv-*.azr"))))
+        assert len(back) == 6
+        by_path = {r.path: r for r in back}
+        for r in recs:
+            b = by_path[r.path]
+            assert b.data == r.data
+            np.testing.assert_allclose(b.gt, r.gt, rtol=1e-6)
+
+
+class TestCaffemodelDrill:
+    def test_complete_fake_caffemodel_loads_into_ssdvgg(self, tmp_path):
+        """A protowire-serialized V2 NetParameter carrying a blob layer
+        for EVERY SSDVgg parameter (Caffe names, OIHW layouts) loads
+        with nothing missing, nothing unused, values bit-equal after
+        layout conversion — the exact code path a real
+        ``VGG_VOC0712_SSD_300x300.caffemodel`` would take."""
+        from analytics_zoo_tpu.models.ssd import SSDVgg
+        from analytics_zoo_tpu.utils.caffe import (CaffeLayer, CaffeNet,
+                                                   load_ssd_vgg_caffe,
+                                                   save_caffemodel)
+        from analytics_zoo_tpu.utils.convert import flatten_params
+
+        model = SSDVgg(num_classes=21, resolution=300)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 300, 300, 3), jnp.float32))
+        params = variables["params"]
+        flat = flatten_params(params)
+
+        # head index → Caffe-SSD source-layer name (SSDVgg.scala:58-70)
+        sources = ["conv4_3_norm", "fc7", "conv6_2", "conv7_2", "conv8_2",
+                   "conv9_2"]
+        rng = np.random.default_rng(0)
+        layers, expect = {}, {}
+        for key, leaf in flat.items():
+            parts = key.split("/")
+            layer, kind = parts[-2], parts[-1]
+            if parts[0] == "conv4_3_norm":        # cmul/weight → Normalize
+                s = rng.standard_normal(leaf.shape).astype(np.float32)
+                layers["conv4_3_norm"] = ("Normalize", {
+                    "scale": s.reshape(1, -1, 1, 1)})
+                expect[key] = s
+                continue
+            if layer.startswith(("loc_", "conf_")):
+                i = int(layer.split("_")[1])
+                head = "loc" if layer.startswith("loc_") else "conf"
+                layer = f"{sources[i]}_mbox_{head}"
+            blobs = layers.setdefault(layer, ("Convolution", {}))[1]
+            if kind == "kernel":                  # flax HWIO → caffe OIHW
+                w = rng.standard_normal(leaf.shape).astype(np.float32)
+                blobs["weight"] = np.transpose(w, (3, 2, 0, 1))
+                expect[key] = w
+            else:
+                b = rng.standard_normal(leaf.shape).astype(np.float32)
+                blobs["bias"] = b
+                expect[key] = b
+
+        net = CaffeNet(name="VGG_VOC0712_SSD_300x300", layers=[
+            CaffeLayer(name, t, [], [],
+                       [blobs[k] for k in ("weight", "bias", "scale")
+                        if k in blobs])
+            for name, (t, blobs) in layers.items()])
+        path = str(tmp_path / "VGG_VOC0712_SSD_300x300.caffemodel")
+        save_caffemodel(path, net)
+        assert os.path.getsize(path) > 10 << 20   # a real-sized byte stream
+
+        new_params, report = load_ssd_vgg_caffe(params, path,
+                                                resolution=300, strict=True)
+        assert not report["missing"], report["missing"][:5]
+        assert not report["unused"], report["unused"][:5]
+        assert len(report["loaded"]) == len(flat)
+        new_flat = flatten_params(new_params)
+        for key, want in expect.items():
+            np.testing.assert_array_equal(np.asarray(new_flat[key]), want)
