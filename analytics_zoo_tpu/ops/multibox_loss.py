@@ -9,8 +9,10 @@ program (SURVEY.md §7.3 hard part #1):
 
 - matching = IoU matrix + per-prior argmax, with each gt's best prior
   force-matched (the bipartite phase) via scatter;
-- hard-negative mining = rank negatives by background conf loss with a
-  double-argsort rank trick, keep the top ``neg_pos_ratio·num_pos``;
+- hard-negative mining = rank negatives by background conf loss (one
+  descending argsort — or a static ``lax.top_k`` window in
+  ``mining="topk"`` mode — plus a scatter of the keep mask) and select
+  the top ``neg_pos_ratio·num_pos``, count-exact;
 - losses are masked sums — no gather/boolean filtering, shapes stay static.
 
 Gradient-explosion guard: the reference skips backward when loss > 50
@@ -42,6 +44,18 @@ class MultiBoxLossParam:
     background_id: int = 0
     neg_pos_ratio: float = 3.0
     neg_overlap: float = 0.5
+    # Hard-negative selection engine (MFU_CEILING.md: mining is ~20% of
+    # the SSD300 train step at 1.3% of its FLOPs).  "sort": one value
+    # sort of the (P,) negative losses — exact reference semantics up to
+    # float ties (the former double-argsort rank trick cost two sorts
+    # for the same selection).  "topk": lax.top_k over a static window
+    # of ``mining_topk`` candidates — cheapest, and exact whenever
+    # ``num_neg = min(3·num_pos, #candidates) <= mining_topk`` (i.e.
+    # fewer than ~mining_topk/3 positive priors per image; beyond that
+    # the negative count is capped at mining_topk, a documented
+    # deviation).
+    mining: str = "sort"
+    mining_topk: int = 1024
 
 
 def match_priors(priors: jax.Array, gt_boxes: jax.Array, gt_mask: jax.Array,
@@ -113,9 +127,22 @@ def multibox_loss(loc_pred: jax.Array, conf_logits: jax.Array,
         neg_loss = jnp.where(neg_cand, -logp[:, param.background_id], -jnp.inf)
         num_neg = jnp.minimum(param.neg_pos_ratio * num_pos,
                               jnp.sum(neg_cand.astype(jnp.float32)))
-        order = jnp.argsort(-neg_loss)                        # desc
-        rank = jnp.argsort(order)                             # rank of each prior
-        neg_selected = (rank < num_neg) & neg_cand
+        # count-exact top-num_neg selection with ONE sort + a scatter
+        # (the former double-argsort rank trick paid a second full sort
+        # for the same mask; a value-threshold variant would be cheaper
+        # still but over-selects whole tie groups — e.g. the uniform
+        # logits of a fresh model — so the count contract would break)
+        if param.mining == "topk":
+            k = min(param.mining_topk, neg_loss.shape[0])
+            _, cand_idx = jax.lax.top_k(neg_loss, k)          # desc (k,)
+            num_neg = jnp.minimum(num_neg, float(k))
+        elif param.mining == "sort":
+            cand_idx = jnp.argsort(-neg_loss)                 # desc (P,)
+        else:
+            raise ValueError(f"unknown mining mode {param.mining!r}")
+        take = jnp.arange(cand_idx.shape[0]) < num_neg
+        neg_selected = (jnp.zeros(neg_loss.shape[0], bool)
+                        .at[cand_idx].set(take)) & neg_cand
 
         conf_loss = jnp.sum(ce * (pos_f + neg_selected.astype(jnp.float32)))
         return param.loc_weight * loc_loss, conf_loss, num_pos

@@ -130,7 +130,8 @@ def main():
 
     wall = time.time() - t_start
     report = {
-        "task": "SSD300-VGG from scratch on rendered-shapes (3 classes)",
+        "task": f"SSD{args.resolution}-VGG from scratch on rendered-shapes "
+                "(3 classes)",
         "final_map_voc07": round(final_map, 4),
         "ap_per_class": {SHAPE_CLASSES[c]: round(float(aps[c]), 4)
                          for c in range(1, n_classes)},
@@ -147,7 +148,7 @@ def main():
         with open(args.out, "a") as f:
             f.write(f"\n## SSD shapes end-to-end ({time.strftime('%Y-%m-%d')})\n\n")
             f.write("Command: `python examples/train_shapes_e2e.py "
-                    f"--epochs {args.epochs}`\n\n```json\n"
+                    + " ".join(sys.argv[1:]) + "`\n\n```json\n"
                     + json.dumps(report, indent=2) + "\n```\n")
     return 0 if final_map > 0.5 else 1
 

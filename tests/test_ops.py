@@ -267,6 +267,33 @@ def test_multibox_loss_hard_negative_ratio():
     np.testing.assert_allclose(float(loss), 3 * np.log(21.0), rtol=1e-4)
 
 
+def test_multibox_loss_topk_mining_matches_sort():
+    """mining="topk" (static lax.top_k window) equals the exact sort
+    engine whenever num_neg fits the window — same loss bit-for-bit on
+    realistic (distinct-loss) data."""
+    rng = np.random.RandomState(11)
+    priors = _grid_priors(6)
+    P = priors.shape[0]
+    var = np.tile([0.1, 0.1, 0.2, 0.2], (P, 1)).astype(np.float32)
+    gt_boxes = np.abs(rng.rand(2, 3, 4)).astype(np.float32)
+    gt_boxes[..., 2:] = np.clip(gt_boxes[..., :2] + 0.3, 0, 1)
+    gt_labels = rng.randint(1, 21, (2, 3)).astype(np.int32)
+    gt_mask = np.ones((2, 3), np.float32)
+    loc = rng.randn(2, P, 4).astype(np.float32) * 0.1
+    conf = rng.randn(2, P, 21).astype(np.float32)
+    a = multibox_loss(jnp.asarray(loc), jnp.asarray(conf),
+                      jnp.asarray(priors), jnp.asarray(var),
+                      jnp.asarray(gt_boxes), jnp.asarray(gt_labels),
+                      jnp.asarray(gt_mask),
+                      MultiBoxLossParam(mining="sort"))
+    b = multibox_loss(jnp.asarray(loc), jnp.asarray(conf),
+                      jnp.asarray(priors), jnp.asarray(var),
+                      jnp.asarray(gt_boxes), jnp.asarray(gt_labels),
+                      jnp.asarray(gt_mask),
+                      MultiBoxLossParam(mining="topk", mining_topk=32))
+    np.testing.assert_allclose(float(a), float(b), rtol=1e-6)
+
+
 def test_multibox_loss_grad_flows():
     priors = _grid_priors(2)
     P = priors.shape[0]

@@ -1,0 +1,97 @@
+"""Does the tunneled relay aggregate host→device bandwidth over
+CONCURRENT transfers?  If K parallel ``device_put`` streams of size S/K
+beat one stream of size S, the e2e train input path should split its
+packed batch across a small thread pool (the link, not the host chain,
+bounds the device-aug e2e headline — BENCH_r03 host_bound 0.82-0.87).
+
+Method: pre- and post-ratchet (the first readback permanently degrades
+the link — pathology #1), measure MB/s for one S-byte transfer vs K
+threads × S/K chunks, alternating single/multi windows to cancel drift.
+Writes one JSON to --out; last stdout line is the summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures as cf
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--mb", type=int, default=16, help="total MB per window")
+    p.add_argument("--streams", type=int, nargs="+", default=[2, 4, 8])
+    p.add_argument("--rounds", type=int, default=3)
+    p.add_argument("--out", default="H2D_STREAMS.json")
+    args = p.parse_args()
+
+    import numpy as np
+    import jax
+
+    dev = jax.devices()[0]
+    total = args.mb << 20
+    buf = np.random.randint(0, 255, (total,), dtype=np.uint8)
+
+    def put_single():
+        t0 = time.perf_counter()
+        out = jax.device_put(buf, dev)
+        jax.block_until_ready(out)
+        return total / (time.perf_counter() - t0) / 1e6
+
+    pools = {k: cf.ThreadPoolExecutor(k) for k in args.streams}
+
+    def put_multi(k):
+        chunks = np.array_split(buf, k)
+
+        def one(c):
+            out = jax.device_put(c, dev)
+            jax.block_until_ready(out)
+            return out
+
+        t0 = time.perf_counter()
+        list(pools[k].map(one, chunks))
+        return total / (time.perf_counter() - t0) / 1e6
+
+    def measure(label):
+        rates = {"single": [], **{f"x{k}": [] for k in args.streams}}
+        for r in range(args.rounds):
+            order = (["single"] + [f"x{k}" for k in args.streams])
+            if r % 2:
+                order = order[::-1]          # alternate to cancel drift
+            for name in order:
+                rate = (put_single() if name == "single"
+                        else put_multi(int(name[1:])))
+                rates[name].append(round(rate, 2))
+        med = {k: sorted(v)[len(v) // 2] for k, v in rates.items()}
+        print(json.dumps({"phase": label, "median_mb_s": med,
+                          "windows": rates}), flush=True)
+        return med
+
+    pre = measure("pre_ratchet")
+    out = jax.device_put(buf[:1024], dev)
+    float(np.asarray(out)[0])                # engage the ratchet
+    post = measure("post_ratchet")
+
+    report = {
+        "total_mb": args.mb, "rounds": args.rounds,
+        "pre_ratchet_mb_s": pre, "post_ratchet_mb_s": post,
+        "pre_best_speedup": round(
+            max(v for k, v in pre.items() if k != "single")
+            / max(pre["single"], 1e-9), 3),
+        "post_best_speedup": round(
+            max(v for k, v in post.items() if k != "single")
+            / max(post["single"], 1e-9), 3),
+    }
+    print(json.dumps(report))
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
