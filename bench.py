@@ -355,7 +355,11 @@ def bench_ds2_train(args, mesh):
     S = 16000 * sec
     n_frames = (S - WINDOW_SIZE) // WINDOW_STRIDE + 1
     n_dev = max(jax.device_count(), 1)
-    B = ((args.ds2_batch + n_dev - 1) // n_dev) * n_dev   # shards over data
+    # training batches bigger than the inference default: the scan-RNN
+    # step is dispatch/latency-bound at batch 8 — batch 32 measured
+    # 2.4-2.5x the records/s at both geometries (BENCH_r04_supplement)
+    B = args.ds2_train_batch if args.ds2_train_batch else 4 * args.ds2_batch
+    B = ((B + n_dev - 1) // n_dev) * n_dev                # shards over data
     rng = np.random.RandomState(0)
     samples = rng.randn(B, S).astype(np.float32) * 0.1
     labels = rng.randint(1, 29, (B, 50)).astype(np.int32)
@@ -831,6 +835,10 @@ def main() -> int:
     p.add_argument("--nms-iters", type=int, default=20)
     p.add_argument("--ds2-seconds", type=int, default=15)
     p.add_argument("--ds2-batch", type=int, default=8)
+    p.add_argument("--ds2-train-batch", type=int, default=0,
+                   help="ds2_train phase batch (0 = 4x --ds2-batch; the "
+                        "scan-RNN train step is latency-bound at small "
+                        "batches)")
     p.add_argument("--ds2-hidden", type=int, default=1024)
     p.add_argument("--ds2-layers", type=int, default=3)
     p.add_argument("--ds2-utts", type=int, default=32)
