@@ -577,8 +577,12 @@ class Validator:
 
     def __init__(self, model: Model, param: PreProcessParam,
                  evaluator: Optional[MeanAveragePrecision] = None,
-                 post: Optional[DetectionOutputParam] = None):
-        self.predictor = SSDPredictor(model, param, post=post)
+                 post: Optional[DetectionOutputParam] = None,
+                 quantize=False):
+        """``quantize`` forwards to :class:`SSDPredictor` — evaluate the
+        int8 serving modes with the same Validator the fp path uses."""
+        self.predictor = SSDPredictor(model, param, post=post,
+                                      quantize=quantize)
         self.evaluator = evaluator or MeanAveragePrecision()
 
     def test(self, dataset) -> DetectionResult:

@@ -46,6 +46,9 @@ def main():
     p.add_argument("--host-aug", action="store_true",
                    help="use the reference-style host OpenCV chain instead "
                         "of device-side augmentation")
+    p.add_argument("--params-out", default=None,
+                   help="save the trained variables here (msgpack) — e.g. "
+                        "for tools/eval_quantized_ssd.py")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -116,6 +119,8 @@ def main():
                    Trigger.max_score(args.target_map),
                    Trigger.max_epoch(args.epochs))))
         opt.optimize()
+        if args.params_out:
+            model.save(args.params_out)
 
         from analytics_zoo_tpu.ops import DetectionOutputParam
         from analytics_zoo_tpu.pipelines.evaluation import MeanAveragePrecision

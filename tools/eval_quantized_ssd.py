@@ -1,0 +1,97 @@
+"""Serving-accuracy cost of int8 quantization on a TRAINED SSD model.
+
+``tests/test_quantize.py`` pins int8 numerics on untrained nets; this
+tool closes the remaining evidence gap: VOC07 mAP of the SAME trained
+weights served three ways — fp, weight-only int8 (``quantize=True``),
+and int8 COMPUTE (``quantize="int8"``) — on a freshly generated shapes
+val set.  Train the weights first, e.g.::
+
+    python examples/train_shapes_e2e.py --target-map 0.9 \
+        --params-out ssd_shapes.msgpack
+    python tools/eval_quantized_ssd.py --params ssd_shapes.msgpack
+
+Writes one JSON to --out (default INT8_MAP_PARITY.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--params", required=True)
+    p.add_argument("--resolution", type=int, default=300)
+    p.add_argument("--val-images", type=int, default=200)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--seed", type=int, default=1,
+                   help="val-set seed (train_shapes_e2e uses seed 1 for "
+                        "its val split)")
+    p.add_argument("--out", default="INT8_MAP_PARITY.json")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.data import SHAPE_CLASSES, generate_shapes_records
+    from analytics_zoo_tpu.models import SSDVgg
+    from analytics_zoo_tpu.ops import DetectionOutputParam
+    from analytics_zoo_tpu.pipelines import PreProcessParam, Validator
+    from analytics_zoo_tpu.pipelines.evaluation import (
+        MeanAveragePrecision, PascalVocEvaluator)
+    from analytics_zoo_tpu.pipelines.ssd import load_val_set
+
+    n_classes = len(SHAPE_CLASSES)
+    res = args.resolution
+    model = Model(SSDVgg(num_classes=n_classes, resolution=res))
+    model.build(0, jnp.zeros((1, res, res, 3), jnp.float32))
+    model.load(args.params)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        generate_shapes_records(os.path.join(tmp, "val"),
+                                n_images=args.val_images, resolution=res,
+                                num_shards=2, seed=args.seed)
+        pre = PreProcessParam(batch_size=args.batch_size, resolution=res,
+                              max_gt=8)
+        results = {}
+        for mode in (False, True, "int8"):
+            val_set = load_val_set(os.path.join(tmp, "val-*.azr"), pre)
+            validator = Validator(
+                model, pre,
+                evaluator=MeanAveragePrecision(n_classes=n_classes),
+                post=DetectionOutputParam(n_classes=n_classes),
+                quantize=mode)
+            r = validator.test(val_set)
+            m = PascalVocEvaluator(class_names=SHAPE_CLASSES).evaluate(r)
+            name = {False: "fp", True: "int8_weight_only",
+                    "int8": "int8_compute"}[mode]
+            results[name] = float(m)       # raw: deltas must not be
+            #                                rounding artifacts
+            print(json.dumps({name: round(results[name], 4)}), flush=True)
+
+    report = {
+        "task": "VOC07 mAP of ONE trained SSD served fp vs int8 "
+                "(weight-only and real int8 compute), same val set",
+        "resolution": res, "val_images": args.val_images,
+        "map": {k: round(v, 4) for k, v in results.items()},
+        "delta_weight_only": round(results["int8_weight_only"]
+                                   - results["fp"], 6),
+        "delta_int8_compute": round(results["int8_compute"]
+                                    - results["fp"], 6),
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(report))
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
