@@ -20,6 +20,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from analytics_zoo_tpu.ops.bbox import decode_bbox
 from analytics_zoo_tpu.ops.nms import nms
@@ -49,6 +50,16 @@ class DetectionOutputParam:
     share_location: bool = True
     clip_boxes: bool = False
     backend: str = "auto"
+    # ``approx_topk`` swaps the per-(image, class) exact ``lax.top_k``
+    # over all P priors — the serve program's dominant non-conv cost —
+    # for TPU's partition-reduce ``lax.approx_max_k`` at the given
+    # recall target.  The ~5% it may miss are candidates ranked near
+    # position ``nms_topk`` (=400) in their class, which NMS or the
+    # global keep-topk would almost surely discard anyway; measured mAP
+    # on a trained model is reported next to the serve bench.  Only the
+    # pallas backend consumes it (the XLA fallback stays exact).
+    approx_topk: bool = False
+    approx_recall: float = 0.95
 
 
 def detection_output_single(loc: jax.Array, conf: jax.Array,
@@ -111,37 +122,49 @@ def _detection_output_pallas(loc: jax.Array, conf: jax.Array,
         lambda l: decode_bbox(priors, variances, l, clip=param.clip_boxes)
     )(loc)                                                  # (B,P,4)
 
-    scores = jnp.swapaxes(conf, 1, 2)                       # (B,C,P)
+    # the background class is discarded from the output, yet it is the
+    # one DENSE row (its softmax score beats conf_thresh on essentially
+    # every prior, so its sweep always runs the full nms_topk
+    # iterations) — drop it before top_k/sweep instead of masking after
+    fg_ids = np.asarray([c for c in range(C) if c != param.background_id],
+                        np.int32)                           # static
+    Cf = len(fg_ids)
+    scores = jnp.swapaxes(conf[..., fg_ids], 1, 2)          # (B,Cf,P)
     masked = jnp.where(scores > param.conf_thresh, scores, -jnp.inf)
     k = min(_round_up(param.nms_topk, 128), _round_up(P, 128))
     kk = min(k, P)
-    top_scores, top_idx = jax.lax.top_k(masked, kk)         # (B,C,kk)
+    if param.approx_topk:
+        # aggregate_to_topk (default) finishes with an exact top_k over
+        # the gathered candidates, so the output stays sorted descending
+        # — the order contract nms_sweep relies on.
+        top_scores, top_idx = jax.lax.approx_max_k(
+            masked, kk, recall_target=param.approx_recall)
+    else:
+        top_scores, top_idx = jax.lax.top_k(masked, kk)     # (B,Cf,kk)
     if k - kk:
         top_scores = jnp.pad(top_scores, ((0, 0), (0, 0), (0, k - kk)),
                              constant_values=-jnp.inf)
         top_idx = jnp.pad(top_idx, ((0, 0), (0, 0), (0, k - kk)))
     boxes = jnp.take_along_axis(decoded[:, None], top_idx[..., None],
-                                axis=2)                     # (B,C,k,4)
+                                axis=2)                     # (B,Cf,k,4)
     # reference nmsFast's topk-400 pre-filter: lanes past nms_topk are
     # padding from rounding k up to the 128-lane multiple
     valid = (jnp.isfinite(top_scores)
              & (jnp.arange(k) < param.nms_topk)).astype(jnp.float32)
 
     def flat(a):
-        return a.reshape(B * C, k)
+        return a.reshape(B * Cf, k)
 
     keep = nms_sweep(flat(boxes[..., 0]), flat(boxes[..., 1]),
                      flat(boxes[..., 2]), flat(boxes[..., 3]), flat(valid),
                      iou_threshold=param.nms_thresh,
-                     interpret=interpret).reshape(B, C, k)
+                     interpret=interpret).reshape(B, Cf, k)
 
-    fg = (jnp.arange(C) != param.background_id).astype(jnp.float32)
-    sel = jnp.where(jnp.isfinite(top_scores), top_scores, 0.0) \
-        * keep * fg[None, :, None]
-    flat_scores = sel.reshape(B, C * k)
+    sel = jnp.where(jnp.isfinite(top_scores), top_scores, 0.0) * keep
+    flat_scores = sel.reshape(B, Cf * k)
     out_scores, order = jax.lax.top_k(flat_scores, param.keep_topk)  # (B,K)
-    out_cls = order // k
-    out_boxes = jnp.take_along_axis(boxes.reshape(B, C * k, 4),
+    out_cls = jnp.asarray(fg_ids)[order // k]
+    out_boxes = jnp.take_along_axis(boxes.reshape(B, Cf * k, 4),
                                     order[..., None], axis=1)
     ok = out_scores > 0
     return jnp.concatenate([

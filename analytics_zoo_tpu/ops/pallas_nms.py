@@ -44,6 +44,14 @@ def _nms_kernel(x1_ref, y1_ref, x2_ref, y2_ref, valid_ref, keep_ref,
     active_ref[:] = valid_ref[:]                    # (1, 1, K) 1.0 = in play
     keep_ref[:] = jnp.zeros_like(keep_ref)
     lane = jax.lax.broadcasted_iota(jnp.int32, (1, 1, k), 2)
+    # candidates arrive sorted by score descending with invalid lanes
+    # masked out, so in practice valid is a prefix and usually short (the
+    # conf_thresh pre-filter kills most of a class's priors).  The sweep
+    # only needs to visit lanes up to the LAST valid one — a dynamic
+    # bound (lowered to a while_loop) that collapses the common sparse
+    # case from K iterations to a handful, and stays correct even for a
+    # non-prefix valid mask.
+    n_valid = jnp.max(jnp.where(valid_ref[:] > 0, lane + 1, 0))
 
     def pick(ref, is_i):
         return jnp.sum(jnp.where(is_i, ref[:], 0.0))
@@ -77,7 +85,7 @@ def _nms_kernel(x1_ref, y1_ref, x2_ref, y2_ref, valid_ref, keep_ref,
 
         return 0
 
-    jax.lax.fori_loop(0, k, body, 0)
+    jax.lax.fori_loop(0, n_valid, body, 0)
 
 
 def nms_sweep(x1, y1, x2, y2, valid, iou_threshold: float = 0.45,

@@ -34,6 +34,12 @@ def main() -> int:
                    help="val-set seed (train_shapes_e2e uses seed 1 for "
                         "its val split)")
     p.add_argument("--out", default="INT8_MAP_PARITY.json")
+    p.add_argument("--approx", action="store_true",
+                   help="also evaluate fp serving with "
+                        "DetectionOutputParam(approx_topk=True) — the "
+                        "recall-0.95 candidate selection — to measure its "
+                        "mAP cost on a trained model (TPU: real "
+                        "approx_max_k; CPU lowering is exact)")
     args = p.parse_args()
 
     import jax
@@ -61,17 +67,25 @@ def main() -> int:
         pre = PreProcessParam(batch_size=args.batch_size, resolution=res,
                               max_gt=8)
         results = {}
-        for mode in (False, True, "int8"):
+        configs = [("fp", False, DetectionOutputParam(n_classes=n_classes)),
+                   ("int8_weight_only", True,
+                    DetectionOutputParam(n_classes=n_classes)),
+                   ("int8_compute", "int8",
+                    DetectionOutputParam(n_classes=n_classes))]
+        if args.approx:
+            configs.append(
+                ("fp_approx_topk", False,
+                 DetectionOutputParam(n_classes=n_classes,
+                                      backend="pallas", approx_topk=True)))
+        for name, mode, post in configs:
             val_set = load_val_set(os.path.join(tmp, "val-*.azr"), pre)
             validator = Validator(
                 model, pre,
                 evaluator=MeanAveragePrecision(n_classes=n_classes),
-                post=DetectionOutputParam(n_classes=n_classes),
+                post=post,
                 quantize=mode)
             r = validator.test(val_set)
             m = PascalVocEvaluator(class_names=SHAPE_CLASSES).evaluate(r)
-            name = {False: "fp", True: "int8_weight_only",
-                    "int8": "int8_compute"}[mode]
             results[name] = float(m)       # raw: deltas must not be
             #                                rounding artifacts
             print(json.dumps({name: round(results[name], 4)}), flush=True)
@@ -87,6 +101,9 @@ def main() -> int:
                                     - results["fp"], 6),
         "backend": jax.default_backend(),
     }
+    if "fp_approx_topk" in results:
+        report["delta_approx_topk"] = round(results["fp_approx_topk"]
+                                            - results["fp"], 6)
     print(json.dumps(report))
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)

@@ -1,0 +1,213 @@
+"""Decompose the SSD serve program: backbone vs DetectionOutput, and
+DetectionOutput's internals (decode+top_k vs the pallas suppression sweep
+vs the global keep-topk).
+
+Round-4 motivation: the int8 compute path wins 1.3x at the conv level
+(INT8_CONV_PROBE.json) yet the serve device-program ratio is ~1.016 —
+i.e. the program is dominated by something that is not convs.  This tool
+names the sink with scoped jitted programs, same timing discipline as
+tools/profile_mfu.py (device-resident inputs, scalar readback fences).
+
+Usage (on the TPU):  python tools/profile_serve.py --batch 128
+Artifact: SERVE_PROFILE.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# Self-contained path setup: PYTHONPATH=/root/repo breaks the axon TPU
+# plugin's entry-point discovery, so the repo root must be added at
+# runtime instead of via the environment.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timed(fn, *args, iters=10, windows=3):
+    import jax
+
+    def fence(out):
+        # scalar readback: the only reliable queue drain on the relay
+        # (block_until_ready under-waits; see tools/profile_mfu.py)
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        float(leaf.ravel()[0])
+
+    fence(fn(*args))                 # compile + drain the first-dispatch
+    fence(fn(*args))                 # backlog (measured ~3 s on axon)
+    best = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn(*args)
+        fence(out)
+        best.append((time.perf_counter() - t0) / iters)
+    best.sort()
+    return best[len(best) // 2]      # median window
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--res", type=int, default=300)
+    p.add_argument("--classes", type=int, default=21)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--out", default="SERVE_PROFILE.json")
+    p.add_argument("--dense-conf", action="store_true",
+                   help="pre-trained-like dense scores instead of the "
+                        "realistic background-dominated distribution")
+    args = p.parse_args()
+
+    import dataclasses
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from analytics_zoo_tpu.models.ssd import SSDDetector, SSDVgg, build_priors
+    from analytics_zoo_tpu.ops.detection_output import (
+        DetectionOutputParam, detection_output)
+    from analytics_zoo_tpu.ops.bbox import decode_bbox
+    from analytics_zoo_tpu.ops.pallas_nms import _round_up, nms_sweep
+    from analytics_zoo_tpu.parallel.train import cast_floating
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    B, res, C = args.batch, args.res, args.classes
+    post = DetectionOutputParam(n_classes=C, backend="auto")
+
+    rng = jax.random.PRNGKey(0)
+    det = SSDDetector(num_classes=C, resolution=res, post=post)
+    x_host = np.random.RandomState(0).rand(B, res, res, 3).astype(np.float32)
+    params = det.init(rng, jnp.zeros((1, res, res, 3), jnp.float32))
+    # serve runs bf16 compute (pipelines.ssd PreProcessParam default)
+    params = cast_floating(params, jnp.bfloat16)
+    x = jax.device_put(x_host.astype(jnp.bfloat16))
+
+    full = jax.jit(lambda p, xx: det.apply(p, xx))
+
+    bb = SSDVgg(num_classes=C, resolution=res)
+    bb_params = {"params": params["params"]["ssd"]}
+    backbone = jax.jit(lambda p, xx: bb.apply(p, xx))
+
+    priors, variances = build_priors(bb.config)
+    priors = np.asarray(priors)
+    variances = np.asarray(variances)
+    P = priors.shape[0]
+    key = jax.random.PRNGKey(1)
+    loc = jax.random.normal(key, (B, P, 4), jnp.float32) * 0.1
+    # realistic serve-time conf: a trained SSD's softmax is background-
+    # dominated — the conf_thresh=0.01 pre-filter kills the vast majority
+    # of (prior, class) scores.  Boost the background logit so fg scores
+    # land mostly under the threshold, with a sprinkle of "detections".
+    logits = jax.random.normal(key, (B, P, C), jnp.float32) * 1.0
+    if not args.dense_conf:
+        logits = logits.at[..., 0].add(10.0)
+        hot = jax.random.bernoulli(jax.random.PRNGKey(2), 0.003, (B, P))
+        logits = logits.at[..., 1:].add(
+            jnp.where(hot[..., None], 12.0, 0.0)
+            * jax.random.uniform(jax.random.PRNGKey(3), (B, P, C - 1)))
+    conf = jax.nn.softmax(logits, axis=-1)
+    loc, conf = jax.device_put(loc), jax.device_put(conf)
+
+    def detout(l, c):
+        return detection_output(l, c, priors, variances, post)
+
+    # -- DetectionOutput internals (mirrors _detection_output_pallas) -----
+    k = min(_round_up(post.nms_topk, 128), _round_up(P, 128))
+
+    from functools import partial as _partial
+
+    Cf = C - 1   # mirrors the fg-only pallas path (background dropped)
+
+    @_partial(jax.jit, static_argnames=("approx",))
+    def stage_topk(loc, conf, approx=False):
+        decoded = jax.vmap(
+            lambda l: decode_bbox(priors, variances, l, clip=False))(loc)
+        scores = jnp.swapaxes(conf[..., 1:], 1, 2)          # (B,Cf,P)
+        masked = jnp.where(scores > post.conf_thresh, scores, -jnp.inf)
+        if approx:
+            top_scores, top_idx = jax.lax.approx_max_k(masked, min(k, P))
+        else:
+            top_scores, top_idx = jax.lax.top_k(masked, min(k, P))
+        boxes = jnp.take_along_axis(decoded[:, None], top_idx[..., None],
+                                    axis=2)
+        return top_scores, top_idx, boxes
+
+    top_scores, top_idx, boxes = jax.block_until_ready(stage_topk(loc, conf))
+    valid = (jnp.isfinite(top_scores)
+             & (jnp.arange(k) < post.nms_topk)).astype(jnp.float32)
+
+    def flat(a):
+        return a.reshape(B * Cf, k)
+
+    fx1, fy1, fx2, fy2 = (flat(boxes[..., i]) for i in range(4))
+    fvalid = flat(valid)
+
+    @jax.jit
+    def stage_sweep(x1, y1, x2, y2, v):
+        return nms_sweep(x1, y1, x2, y2, v, iou_threshold=post.nms_thresh,
+                         interpret=not on_tpu)
+
+    keep = jax.block_until_ready(stage_sweep(fx1, fy1, fx2, fy2, fvalid))
+
+    @jax.jit
+    def stage_final(top_scores, keep, boxes):
+        kk = keep.reshape(B, Cf, k)
+        sel = jnp.where(jnp.isfinite(top_scores), top_scores, 0.0) * kk
+        out_scores, order = jax.lax.top_k(sel.reshape(B, Cf * k),
+                                          post.keep_topk)
+        out_boxes = jnp.take_along_axis(boxes.reshape(B, Cf * k, 4),
+                                        order[..., None], axis=1)
+        return out_scores, out_boxes
+
+    t_full = timed(full, params, x, iters=args.iters)
+    t_backbone = timed(backbone, bb_params, x, iters=args.iters)
+    t_detout = timed(detout, loc, conf, iters=args.iters)
+    t_topk = timed(stage_topk, loc, conf, iters=args.iters)
+    try:
+        t_topk_approx = timed(lambda l, c: stage_topk(l, c, approx=True),
+                              loc, conf, iters=args.iters)
+    except Exception as e:   # approx_max_k unsupported on this backend
+        print(f"approx_max_k unavailable: {e}", file=sys.stderr)
+        t_topk_approx = float("nan")
+    t_sweep = timed(stage_sweep, fx1, fy1, fx2, fy2, fvalid,
+                    iters=args.iters)
+    t_final = timed(stage_final, top_scores, keep, boxes, iters=args.iters)
+    valid_counts = jax.device_get(jnp.sum(fvalid, axis=1))
+
+    result = {
+        "device": jax.devices()[0].device_kind,
+        "batch": B, "resolution": res, "classes": C, "priors": int(P),
+        "sweep_lanes_k": int(k), "grid_instances": int(B * Cf),
+        "ms": {
+            "full_serve_program": round(t_full * 1e3, 2),
+            "backbone_only": round(t_backbone * 1e3, 2),
+            "detection_output_total": round(t_detout * 1e3, 2),
+            "detout_decode_topk": round(t_topk * 1e3, 2),
+            "detout_decode_topk_approx": round(t_topk_approx * 1e3, 2),
+            "detout_pallas_sweep": round(t_sweep * 1e3, 2),
+            "detout_final_topk": round(t_final * 1e3, 2),
+        },
+        "conf_distribution": ("dense" if args.dense_conf
+                              else "background-dominated (realistic)"),
+        "valid_candidates_per_class_row": {
+            "mean": round(float(valid_counts.mean()), 1),
+            "p95": round(float(np.percentile(valid_counts, 95)), 1),
+            "max": int(valid_counts.max()),
+        },
+        "detout_fraction_of_serve": round(t_detout / max(t_full, 1e-9), 3),
+        "images_per_sec_full": round(B / t_full, 1),
+        "images_per_sec_backbone_only": round(B / t_backbone, 1),
+        "note": "device-resident inputs; scalar-readback-fenced windows; "
+                "bf16 backbone compute to match the serve path",
+    }
+    print(json.dumps(result, indent=2))
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
