@@ -53,11 +53,14 @@ class DetectionOutputParam:
     # ``approx_topk`` swaps the per-(image, class) exact ``lax.top_k``
     # over all P priors — the serve program's dominant non-conv cost —
     # for TPU's partition-reduce ``lax.approx_max_k`` at the given
-    # recall target.  The ~5% it may miss are candidates ranked near
-    # position ``nms_topk`` (=400) in their class, which NMS or the
-    # global keep-topk would almost surely discard anyway; measured mAP
-    # on a trained model is reported next to the serve bench.  Only the
-    # pallas backend consumes it (the XLA fallback stays exact).
+    # recall target.  The ~(1-recall) misses are NOT confined to ranks
+    # near ``nms_topk``: approx_max_k partitions the input and keeps
+    # bin-local maxima, so any element colliding with a larger one in
+    # its bin can drop — including a top-scoring detection.  The
+    # guardrail is therefore empirical: measured mAP delta on a trained
+    # model is reported next to the serve bench, and the default stays
+    # exact (``approx_topk=False``).  Only the pallas backend consumes
+    # it (the XLA fallback stays exact).
     approx_topk: bool = False
     approx_recall: float = 0.95
 

@@ -46,7 +46,8 @@ def pipeline_forward(apply_block: Callable[[Any, jax.Array], jax.Array],
                      microbatches: jax.Array,
                      mesh: Mesh,
                      axis_name: str = PIPE_AXIS,
-                     batch_axis: Optional[str] = None) -> jax.Array:
+                     batch_axis: Optional[str] = None,
+                     param_specs: Optional[Any] = None) -> jax.Array:
     """Run ``y_m = block_{L-1}(... block_0(x_m))`` for every microbatch.
 
     ``apply_block(stage_params, x) → y`` must preserve x's shape (uniform
@@ -54,6 +55,15 @@ def pipeline_forward(apply_block: Callable[[Any, jax.Array], jax.Array],
     ``stacked_params``: leading dim L == size of ``axis_name``.
     ``microbatches``: (M, B, ...) — M microbatches, replicated over the
     pipe axis (or sharded over ``batch_axis`` on dim 1 for 2-D meshes).
+
+    ``param_specs`` (optional): a pytree of ``PartitionSpec`` matching
+    ``stacked_params`` that REPLACES the default ``P(axis_name)`` —
+    for composing pipeline with tensor parallelism: e.g. a Megatron
+    col/row pair inside each stage uses
+    ``{"w1": P("pipe", None, "model"), "w2": P("pipe", "model", None)}``
+    and closes the pair with ``jax.lax.psum(..., "model")`` inside
+    ``apply_block`` (which runs inside shard_map, so every mesh axis
+    name is in scope).  Every spec's dim 0 must still be ``axis_name``.
 
     Returns (M, B, ...) outputs, replicated like the input.
     """
@@ -66,8 +76,17 @@ def pipeline_forward(apply_block: Callable[[Any, jax.Array], jax.Array],
         raise ValueError(
             f"stacked_params has {n_stages} stages but the {axis_name!r} "
             f"axis has {L} devices — one stage per device required")
-    stage_spec = jax.tree_util.tree_map(
-        lambda _: P(axis_name), stacked_params)
+    if param_specs is None:
+        stage_spec = jax.tree_util.tree_map(
+            lambda _: P(axis_name), stacked_params)
+    else:
+        stage_spec = param_specs
+        for s in jax.tree_util.tree_leaves(
+                stage_spec, is_leaf=lambda x: isinstance(x, P)):
+            if not s or s[0] != axis_name:
+                raise ValueError(
+                    f"param_specs leaf {s} must shard dim 0 over "
+                    f"{axis_name!r} (one stage per pipe device)")
     mb_spec = P(None, batch_axis)
 
     def local(params_l, mbs):

@@ -1,18 +1,33 @@
-"""Post-training int8 weight quantization for serving.
+"""Post-training int8 quantization for serving — two modes.
 
 Net-new capability (the reference serves fp32 through MKL; SURVEY.md
-§2.6).  TPU-first design: weights are stored as per-output-channel
-symmetric int8 (``QTensor`` — int8 values + one fp32 scale per trailing
-axis), cutting parameter HBM ~4×; the forward **dequantizes inside
-jit**, so XLA fuses the ``q * scale`` broadcast into the adjacent
-matmul/conv and the bf16/fp32 MXU path is unchanged.  No activation
-quantization — this is lossless-ergonomics serving compression, not QAT.
+§2.6).  Weights are stored as per-output-channel symmetric int8
+(``QTensor`` — int8 values + one fp32 scale per trailing axis), cutting
+parameter HBM ~4×.  From that shared storage, two serving modes:
+
+1. **Weight-only** (``quantize=True``): the forward dequantizes inside
+   jit, so XLA fuses the ``q * scale`` broadcast into the adjacent
+   matmul/conv and the bf16/fp32 MXU path is unchanged.  Lossless-
+   ergonomics compression — identical arithmetic, smaller params.
+2. **Int8 compute** (``quantize="int8"``): a flax method interceptor
+   (``_int8_interceptor`` below) dynamically quantizes conv activations
+   per-tensor and runs real ``int8×int8→int32`` convolutions on the
+   MXU (``lax.conv_general_dilated`` with ``preferred_element_type=
+   int32``), rescaling once on the way out.  Measured: 1.3× at the
+   conv level (``INT8_CONV_PROBE.json``), mAP delta +0.000145 on a
+   trained model (``INT8_MAP_PARITY.json``); e2e serve gain is
+   link-weather-limited (~1.02–1.10×, ``docs/PERFORMANCE.md``).
+
+Which layers quantize is an abstract-trace census (``QTensor`` hygiene:
+every int8 leaf must be consumed by exactly one conv/matmul), not a
+name-pattern guess — see ``quantize_params``.
 
 Usage::
 
     qparams = quantize_params(model.params)         # ~4x smaller pytree
-    fwd = make_quantized_forward(model.module)      # jitted
+    fwd = make_quantized_forward(model.module)      # weight-only
     y = fwd(qparams, x)                             # == model.forward(x) ± eps
+    fwd8 = make_quantized_forward(model.module, compute="int8")
 """
 
 from __future__ import annotations

@@ -57,7 +57,11 @@ PEAK_TFLOPS = {
 
 
 def _median(xs):
-    return sorted(xs)[len(xs) // 2]        # odd count
+    s = sorted(xs)
+    n = len(s)
+    if n % 2 == 1:
+        return s[n // 2]
+    return 0.5 * (s[n // 2 - 1] + s[n // 2])
 
 
 def _interleaved_ab(fn_a, fn_b, windows: int = 3, on_pair=None):
@@ -237,7 +241,7 @@ def bench_ssd_train(args, mesh, shard_pattern, device_aug: bool):
     return per_chip, images_per_sec, loss
 
 
-def bench_ssd_serve(args, mesh, records):
+def bench_ssd_serve(args, mesh, records, res=None):
     import jax
 
     import jax.numpy as jnp
@@ -247,10 +251,13 @@ def bench_ssd_serve(args, mesh, records):
     from analytics_zoo_tpu.ops import DetectionOutputParam
     from analytics_zoo_tpu.pipelines.ssd import PreProcessParam, SSDPredictor
 
-    res = args.res
+    res = res or args.res
+    # 512 serve: forward-only fits a bigger batch than 512 TRAIN does,
+    # but 2.9x the pixels per image still means halving vs the 300 batch
+    batch = args.batch if res == args.res else max(args.batch // 2, 1)
     model = Model(SSDVgg(num_classes=args.classes, resolution=res))
     model.build(0, jnp.zeros((1, res, res, 3), jnp.float32))
-    param = PreProcessParam(batch_size=args.batch, resolution=res,
+    param = PreProcessParam(batch_size=batch, resolution=res,
                             num_workers=args.workers,
                             wire_format=args.wire_format)
     on_tpu = jax.default_backend() in ("tpu", "axon")
@@ -260,8 +267,8 @@ def bench_ssd_serve(args, mesh, records):
         compute_dtype=args.compute_dtype)
 
     def _time_predict(p):
-        warm = p.predict(records[:args.batch])               # compile
-        assert len(warm) == args.batch
+        warm = p.predict(records[:batch])               # compile
+        assert len(warm) == batch
         t0 = time.perf_counter()
         out = p.predict(records)
         dt = time.perf_counter() - t0
@@ -269,10 +276,10 @@ def bench_ssd_serve(args, mesh, records):
         return len(records) / dt / max(jax.device_count(), 1)
 
     per_chip = _time_predict(predictor)
-    _emit(f"ssd{args.res}_serve_images_per_sec_per_chip", per_chip,
+    _emit(f"ssd{res}_serve_images_per_sec_per_chip", per_chip,
           "images/sec/chip", None,
           nms_backend="pallas" if on_tpu else "xla",  # auto-resolved
-          batch=args.batch, wire_format=args.wire_format,
+          batch=batch, wire_format=args.wire_format,
           note="decode+preprocess+forward+DetectionOutput+rescale; "
                "no published reference anchor")
 
@@ -298,7 +305,7 @@ def bench_ssd_serve(args, mesh, records):
     import numpy as _np
 
     x_dev = jax.device_put(_np.random.RandomState(0).rand(
-        args.batch, res, res, 3).astype(_np.float32))
+        batch, res, res, 3).astype(_np.float32))
 
     def _time_device(p, iters=10):
         o = p.detect_normalized(x_dev)
@@ -307,11 +314,11 @@ def bench_ssd_serve(args, mesh, records):
         for _ in range(iters):
             o = p.detect_normalized(x_dev)
         _np.asarray(o)                           # fence
-        return args.batch * iters / (time.perf_counter() - t0)
+        return batch * iters / (time.perf_counter() - t0)
 
     dfp, dq, dratio = _interleaved_ab(lambda: _time_device(predictor),
                                       lambda: _time_device(q_predictor))
-    _emit(f"ssd{args.res}_serve_int8_device_speedup", _median(dratio), "x",
+    _emit(f"ssd{res}_serve_int8_device_speedup", _median(dratio), "x",
           None, fp_images_per_sec_one_device=round(_median(dfp), 1),
           int8_images_per_sec_one_device=round(_median(dq), 1),
           note="fused forward+DetectionOutput on a SINGLE-device resident "
@@ -320,7 +327,7 @@ def bench_ssd_serve(args, mesh, records):
                "e2e serve path")
 
     per_chip_q = _median(q_rates)
-    return _emit(f"ssd{args.res}_serve_int8_images_per_sec_per_chip", per_chip_q,
+    return _emit(f"ssd{res}_serve_int8_images_per_sec_per_chip", per_chip_q,
                  "images/sec/chip", _median(ratios),
                  fp_windows=[round(x, 2) for x in fp_rates],
                  int8_windows=[round(x, 2) for x in q_rates],
@@ -542,6 +549,96 @@ def bench_ssd512_step(args, mesh):
                  final_loss=round(loss, 3), device_kind=kind, **extra,
                  note="bf16 fwd+bwd+update on a device-resident batch, "
                       "7-head SSD512 geometry (SSDVgg.scala:58-70 parity)")
+
+
+def bench_frcnn_train(args, mesh):
+    """Faster-RCNN TRAINING device-step throughput + MFU (VERDICT r4 item
+    7: training throughput existed only as an ACCURACY.md aside).  Same
+    discipline as bench_ssd512_step: bf16 fwd+bwd+update on a
+    device-resident batch — approximate-joint losses (RPN + head,
+    ``ops/frcnn_train.py``) with gt boxes injected as extra ROIs, the
+    full in-graph proposal/ROI-pool path in the backward."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.models import FasterRcnnVgg, FrcnnParam
+    from analytics_zoo_tpu.ops import ProposalParam
+    from analytics_zoo_tpu.ops.frcnn_train import (FrcnnLossParam,
+                                                   frcnn_training_loss)
+    from analytics_zoo_tpu.parallel import (
+        SGD, create_train_state, make_train_step, replicate)
+    from analytics_zoo_tpu.parallel import mesh as mesh_lib
+
+    res = 512 if not args.quick else 128
+    # py-faster-rcnn trains near batch 1-2 at ~600px; on TPU we batch —
+    # VGG fwd+bwd at 512 fits 8/chip comfortably (SSD512 fits 32)
+    B = max(min(args.batch // 16, 8), 1) * max(jax.device_count(), 1)
+    param = FrcnnParam(
+        num_classes=args.classes,
+        proposal=ProposalParam(pre_nms_topn=2000 if not args.quick else 64,
+                               post_nms_topn=128 if not args.quick else 16))
+    model = Model(FasterRcnnVgg(param=param))
+    model.build(0, jnp.zeros((1, res, res, 3), jnp.float32),
+                jnp.asarray([[res, res, 1.0]], jnp.float32))
+    loss_param = FrcnnLossParam()
+    module = model.module
+
+    def forward_fn(variables, inputs, train=False, rngs=None):
+        x, im_info, gt_px, gt_mask = inputs
+        out = module.apply(variables, x, im_info, train=train,
+                           extra_rois=gt_px, extra_rois_mask=gt_mask,
+                           train_outputs=True, rngs=rngs)
+        return out, None
+
+    def criterion(outputs, batch):
+        return frcnn_training_loss(outputs, batch, loss_param)
+
+    optim = SGD(1e-3, momentum=0.9)
+    state = replicate(create_train_state(model, optim), mesh)
+    step = make_train_step(module, criterion, optim, mesh=mesh,
+                           compute_dtype=args.compute_dtype,
+                           forward_fn=forward_fn)
+    rng = np.random.RandomState(0)
+    G = 4
+    gt_px = np.tile(np.asarray([0.1, 0.1, 0.6, 0.6], np.float32) * res,
+                    (B, G, 1))
+    gt_mask = np.ones((B, G), np.float32)
+    im_info = np.tile(np.asarray([[res, res, 1.0]], np.float32), (B, 1))
+    batch = mesh_lib.shard_batch({
+        "input": (rng.rand(B, res, res, 3).astype(np.float32), im_info,
+                  gt_px, gt_mask),
+        "im_info": im_info,
+        "target": {"bboxes": gt_px,
+                   "labels": np.ones((B, G), np.int32),
+                   "mask": gt_mask},
+    }, mesh)
+    state, m = step(state, batch, 1.0)               # compile
+    float(np.asarray(m["loss"]))                     # readback fence
+    flops = _flops_per_step(step, state, batch, 1.0)
+    steps = max(4, args.steps // 3)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, batch, 1.0)
+    loss = float(np.asarray(m["loss"]))              # fence
+    dt = time.perf_counter() - t0
+    n_chips = max(jax.device_count(), 1)
+    per_chip = B * steps / dt / n_chips
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_TFLOPS.get(kind)
+    extra = {}
+    if flops > 0 and peak:
+        tflops = flops / (dt / steps) / 1e12 / n_chips
+        extra = {"model_tflops_per_chip": round(tflops, 2),
+                 "mfu": round(tflops / peak, 4), "peak_tflops": peak}
+    return _emit("frcnn_train_step_images_per_sec_per_chip", per_chip,
+                 "images/sec/chip", None, batch=B, resolution=res,
+                 final_loss=round(loss, 3), device_kind=kind, **extra,
+                 note="bf16 fwd+bwd+update, device-resident batch; "
+                      "RPN+head approximate-joint losses with in-graph "
+                      "proposal/ROI-pool — a capability the reference "
+                      "does not have (Proposal.scala throws on backward)")
 
 
 def bench_overlap(args, mesh, shard_pattern):
@@ -852,8 +949,8 @@ def main() -> int:
                         "the median is climate)")
     p.add_argument("--skip", default="",
                    help="comma list: link,nms,ds2,ds2_train,ssd_serve,"
-                        "frcnn_serve,ssd512_step,overlap,ssd_train,"
-                        "ssd_train_hostaug")
+                        "ssd512_serve,frcnn_serve,frcnn_train,"
+                        "ssd512_step,overlap,ssd_train,ssd_train_hostaug")
     p.add_argument("--no-isolate", action="store_true",
                    help="run all phases in THIS process instead of one "
                         "subprocess per phase (see note in main)")
@@ -879,7 +976,8 @@ def main() -> int:
     # the link probe leads (it contextualizes every later number);
     # ssd_train stays last (the driver reads the LAST line as headline)
     ALL_PHASES = ["link", "nms", "ds2", "ds2_train", "ssd_serve",
-                  "frcnn_serve", "ssd512_step", "overlap",
+                  "ssd512_serve", "frcnn_serve", "frcnn_train",
+                  "ssd512_step", "overlap",
                   "ssd_train_hostaug", "ssd_train"]
     if not args.child and not args.no_isolate:
         # One SUBPROCESS per phase: the tunneled-TPU relay degrades
@@ -997,10 +1095,20 @@ def main() -> int:
                 # median-by-value sweep becomes THE headline (last line);
                 # every per-sweep line stays above it for the judge
                 ordered = sorted(sweep_headlines, key=lambda d: d["value"])
-                median = dict(ordered[len(ordered) // 2])
+                med_value = _median([d["value"] for d in sweep_headlines])
+                # base the headline dict on the sweep nearest the median so
+                # its ancillary fields (loss, hbf) describe a real run, but
+                # the VALUE is the true median — on even counts that is the
+                # mean of the two middle sweeps, never the upper one
+                median = dict(min(ordered,
+                                  key=lambda d: abs(d["value"] - med_value)))
+                median["value"] = round(med_value, 3)
+                median["vs_baseline"] = round(
+                    med_value / REFERENCE_ANCHOR_IMAGES_PER_SEC, 3)
                 median["headline_policy"] = (
                     f"median of {len(sweep_headlines)} independent "
-                    "subprocess sweeps (fresh relay link draw each)")
+                    "subprocess sweeps (fresh relay link draw each); even "
+                    "count = mean of the two middle sweeps")
                 median["sweep_values"] = [d["value"] for d in sweep_headlines]
                 if sweep_hbfs:
                     median["host_bound_fraction_per_sweep"] = [
@@ -1018,7 +1126,7 @@ def main() -> int:
     n_dev = jax.device_count()
     if args.batch % n_dev:          # batch shards over the data axis
         args.batch = ((args.batch + n_dev - 1) // n_dev) * n_dev
-    needs_shards = {"ssd_serve", "frcnn_serve", "ssd_train",
+    needs_shards = {"ssd_serve", "ssd512_serve", "frcnn_serve", "ssd_train",
                     "ssd_train_hostaug", "overlap"} - skip
     with tempfile.TemporaryDirectory() as tmp:
         pattern = os.path.join(tmp, "shapes-*.azr")
@@ -1055,6 +1163,11 @@ def main() -> int:
             bench_ds2_train(args, mesh)
         if "frcnn_serve" not in skip:
             bench_frcnn_serve(args, mesh, records[:min(len(records), 64)])
+        if "ssd512_serve" not in skip and not args.quick:
+            bench_ssd_serve(args, mesh, records[:min(len(records), 128)],
+                            res=512)
+        if "frcnn_train" not in skip:
+            bench_frcnn_train(args, mesh)
         if "ssd512_step" not in skip and not args.quick:
             bench_ssd512_step(args, mesh)
         if headline is not None:

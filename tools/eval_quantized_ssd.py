@@ -73,6 +73,14 @@ def main() -> int:
                    ("int8_compute", "int8",
                     DetectionOutputParam(n_classes=n_classes))]
         if args.approx:
+            if jax.default_backend() not in ("tpu", "axon"):
+                # CPU lowers approx_max_k exactly AND runs the pallas
+                # kernel in interpret mode: delta_approx_topk == 0 by
+                # construction there — not evidence of TPU safety
+                print("WARNING: --approx on a non-TPU backend: "
+                      "approx_max_k lowers EXACTLY here, so "
+                      "delta_approx_topk==0 is vacuous; run on TPU for "
+                      "meaningful data", file=sys.stderr)
             configs.append(
                 ("fp_approx_topk", False,
                  DetectionOutputParam(n_classes=n_classes,

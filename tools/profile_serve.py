@@ -2,11 +2,24 @@
 DetectionOutput's internals (decode+top_k vs the pallas suppression sweep
 vs the global keep-topk).
 
-Round-4 motivation: the int8 compute path wins 1.3x at the conv level
-(INT8_CONV_PROBE.json) yet the serve device-program ratio is ~1.016 —
-i.e. the program is dominated by something that is not convs.  This tool
-names the sink with scoped jitted programs, same timing discipline as
-tools/profile_mfu.py (device-resident inputs, scalar readback fences).
+Coherence contract (round-5): the decomposition must SUM — ``full ≈
+backbone + detection_output (+ small jit-boundary residual)``.  The
+round-4 version violated this: the full program ran untrained init
+params (dense, near-uniform softmax → the sweep's slow path) while the
+standalone DetectionOutput stage was fed synthetic sparse
+"trained-like" conf, so ``detout_fraction_of_serve`` divided a
+sparse-case numerator by a dense-case denominator.  Now:
+
+- the init params get a trained-like prior baked in: every conf head's
+  BACKGROUND bias channel (layout ``a*C + 0`` — see
+  ``models/ssd.py:224-227``) is shifted +bg_bias, so the full program's
+  internal softmax is background-dominated exactly like a trained SSD's
+  (reference ``common/nn/DetectionOutput.scala:171`` serves post-softmax
+  scores with conf_thresh=0.01 killing the vast majority);
+- every standalone stage (detout, decode+topk, sweep, final topk) is
+  timed on the (loc, conf) the biased backbone ACTUALLY produced, not a
+  synthetic distribution — parts and whole see the same data;
+- the residual ``full - (backbone + detout)`` is reported explicitly.
 
 Usage (on the TPU):  python tools/profile_serve.py --batch 128
 Artifact: SERVE_PROFILE.json
@@ -47,6 +60,32 @@ def timed(fn, *args, iters=10, windows=3):
     return best[len(best) // 2]      # median window
 
 
+def bias_background(params, num_classes: float, bg_bias: float):
+    """Shift every conf head's background-channel bias by ``bg_bias``.
+
+    Conf heads are ``nn.Conv(k*C)`` named ``conf_{i}`` whose output is
+    reshaped ``(B, -1, C)`` (models/ssd.py:224-227), so bias channel
+    ``j`` maps to class ``j % C`` — background is ``j % C == 0``.
+    """
+    import jax.numpy as jnp
+
+    def walk(tree):
+        out = {}
+        for name, sub in tree.items():
+            if name.startswith("conf_") and "bias" in sub:
+                b = sub["bias"]
+                mask = (jnp.arange(b.shape[0]) % num_classes) == 0
+                out[name] = dict(sub)
+                out[name]["bias"] = b + bg_bias * mask.astype(b.dtype)
+            elif isinstance(sub, dict):
+                out[name] = walk(sub)
+            else:
+                out[name] = sub
+        return out
+
+    return walk(params)
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=128)
@@ -54,13 +93,11 @@ def main() -> int:
     p.add_argument("--classes", type=int, default=21)
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--out", default="SERVE_PROFILE.json")
-    p.add_argument("--dense-conf", action="store_true",
-                   help="pre-trained-like dense scores instead of the "
-                        "realistic background-dominated distribution")
+    p.add_argument("--bg-bias", type=float, default=8.0,
+                   help="background-logit shift baked into the conf head "
+                        "biases; 0 reproduces the untrained dense-conf "
+                        "slow path for comparison")
     args = p.parse_args()
-
-    import dataclasses
-    from functools import partial
 
     import jax
     import jax.numpy as jnp
@@ -81,6 +118,10 @@ def main() -> int:
     det = SSDDetector(num_classes=C, resolution=res, post=post)
     x_host = np.random.RandomState(0).rand(B, res, res, 3).astype(np.float32)
     params = det.init(rng, jnp.zeros((1, res, res, 3), jnp.float32))
+    # bake the trained-like background prior into the params the FULL
+    # program runs — the whole and the parts must see the same conf
+    # distribution for the decomposition to sum
+    params = {"params": bias_background(params["params"], C, args.bg_bias)}
     # serve runs bf16 compute (pipelines.ssd PreProcessParam default)
     params = cast_floating(params, jnp.bfloat16)
     x = jax.device_put(x_host.astype(jnp.bfloat16))
@@ -95,20 +136,12 @@ def main() -> int:
     priors = np.asarray(priors)
     variances = np.asarray(variances)
     P = priors.shape[0]
-    key = jax.random.PRNGKey(1)
-    loc = jax.random.normal(key, (B, P, 4), jnp.float32) * 0.1
-    # realistic serve-time conf: a trained SSD's softmax is background-
-    # dominated — the conf_thresh=0.01 pre-filter kills the vast majority
-    # of (prior, class) scores.  Boost the background logit so fg scores
-    # land mostly under the threshold, with a sprinkle of "detections".
-    logits = jax.random.normal(key, (B, P, C), jnp.float32) * 1.0
-    if not args.dense_conf:
-        logits = logits.at[..., 0].add(10.0)
-        hot = jax.random.bernoulli(jax.random.PRNGKey(2), 0.003, (B, P))
-        logits = logits.at[..., 1:].add(
-            jnp.where(hot[..., None], 12.0, 0.0)
-            * jax.random.uniform(jax.random.PRNGKey(3), (B, P, C - 1)))
-    conf = jax.nn.softmax(logits, axis=-1)
+
+    # the standalone stages run on the loc/conf the biased backbone
+    # ACTUALLY produces — same data the full program's detout sees
+    loc_raw, conf_logits = jax.block_until_ready(backbone(bb_params, x))
+    loc = loc_raw.astype(jnp.float32)
+    conf = jax.nn.softmax(conf_logits.astype(jnp.float32), axis=-1)
     loc, conf = jax.device_put(loc), jax.device_put(conf)
 
     def detout(l, c):
@@ -127,10 +160,18 @@ def main() -> int:
             lambda l: decode_bbox(priors, variances, l, clip=False))(loc)
         scores = jnp.swapaxes(conf[..., 1:], 1, 2)          # (B,Cf,P)
         masked = jnp.where(scores > post.conf_thresh, scores, -jnp.inf)
+        kk = min(k, P)
         if approx:
-            top_scores, top_idx = jax.lax.approx_max_k(masked, min(k, P))
+            top_scores, top_idx = jax.lax.approx_max_k(masked, kk)
         else:
-            top_scores, top_idx = jax.lax.top_k(masked, min(k, P))
+            top_scores, top_idx = jax.lax.top_k(masked, kk)
+        if kk < k:   # pad to the sweep's lane count, as the real
+            # _detection_output_pallas does (advisor r4: unpadded lanes
+            # break the arange(k) mask below for small prior counts)
+            pad = k - kk
+            top_scores = jnp.pad(top_scores, ((0, 0), (0, 0), (0, pad)),
+                                 constant_values=-jnp.inf)
+            top_idx = jnp.pad(top_idx, ((0, 0), (0, 0), (0, pad)))
         boxes = jnp.take_along_axis(decoded[:, None], top_idx[..., None],
                                     axis=2)
         return top_scores, top_idx, boxes
@@ -171,27 +212,39 @@ def main() -> int:
                               loc, conf, iters=args.iters)
     except Exception as e:   # approx_max_k unsupported on this backend
         print(f"approx_max_k unavailable: {e}", file=sys.stderr)
-        t_topk_approx = float("nan")
+        t_topk_approx = None
     t_sweep = timed(stage_sweep, fx1, fy1, fx2, fy2, fvalid,
                     iters=args.iters)
     t_final = timed(stage_final, top_scores, keep, boxes, iters=args.iters)
     valid_counts = jax.device_get(jnp.sum(fvalid, axis=1))
 
+    residual = t_full - (t_backbone + t_detout)
     result = {
         "device": jax.devices()[0].device_kind,
         "batch": B, "resolution": res, "classes": C, "priors": int(P),
         "sweep_lanes_k": int(k), "grid_instances": int(B * Cf),
+        "bg_bias": args.bg_bias,
         "ms": {
             "full_serve_program": round(t_full * 1e3, 2),
             "backbone_only": round(t_backbone * 1e3, 2),
             "detection_output_total": round(t_detout * 1e3, 2),
+            "residual_jit_boundary": round(residual * 1e3, 2),
             "detout_decode_topk": round(t_topk * 1e3, 2),
-            "detout_decode_topk_approx": round(t_topk_approx * 1e3, 2),
+            "detout_decode_topk_approx": (
+                None if t_topk_approx is None
+                else round(t_topk_approx * 1e3, 2)),
             "detout_pallas_sweep": round(t_sweep * 1e3, 2),
             "detout_final_topk": round(t_final * 1e3, 2),
         },
-        "conf_distribution": ("dense" if args.dense_conf
-                              else "background-dominated (realistic)"),
+        "coherence": {
+            "parts_sum_ms": round((t_backbone + t_detout) * 1e3, 2),
+            "full_ms": round(t_full * 1e3, 2),
+            "residual_fraction": round(residual / max(t_full, 1e-9), 3),
+        },
+        "conf_distribution": (
+            "untrained dense (bg_bias=0)" if args.bg_bias == 0 else
+            f"trained-like: background bias +{args.bg_bias} baked into "
+            "the conf heads; stages timed on the backbone's real output"),
         "valid_candidates_per_class_row": {
             "mean": round(float(valid_counts.mean()), 1),
             "p95": round(float(np.percentile(valid_counts, 95)), 1),
@@ -201,7 +254,8 @@ def main() -> int:
         "images_per_sec_full": round(B / t_full, 1),
         "images_per_sec_backbone_only": round(B / t_backbone, 1),
         "note": "device-resident inputs; scalar-readback-fenced windows; "
-                "bf16 backbone compute to match the serve path",
+                "bf16 backbone compute to match the serve path; whole and "
+                "parts share one conf distribution (see module docstring)",
     }
     print(json.dumps(result, indent=2))
     with open(args.out, "w") as f:
