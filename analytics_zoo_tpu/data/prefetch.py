@@ -13,6 +13,42 @@ import threading
 from typing import Any, Iterable, Iterator, Optional
 
 from analytics_zoo_tpu.parallel import mesh as mesh_lib
+from analytics_zoo_tpu.resilience.errors import PrefetchWorkerDied
+
+
+def _drain(q: "queue.Queue", stop: object, err: list, worker,
+           poll_s: float = 0.2) -> Iterator[Any]:
+    """Consumer side of the prefetch queue.
+
+    A bare ``q.get()`` would block FOREVER if the worker thread died
+    without enqueueing the stop sentinel (killed interpreter thread,
+    c-extension abort) — the silent-hang failure mode.  Poll with a
+    timeout instead and, when the queue is empty AND the worker is dead,
+    raise a descriptive error: the worker's recorded exception if it left
+    one, else :class:`PrefetchWorkerDied`."""
+    while True:
+        try:
+            item = q.get(timeout=poll_s)
+        except queue.Empty:
+            if worker.is_alive():
+                continue
+            # worker is gone, so nothing more can be enqueued — but it
+            # may have delivered its tail (and the sentinel) between our
+            # timeout and the liveness check: drain before declaring death
+            try:
+                item = q.get_nowait()
+            except queue.Empty:
+                if err:
+                    raise err[0]
+                raise PrefetchWorkerDied(
+                    "prefetch worker thread died without delivering its "
+                    "stop sentinel (no exception recorded) — input "
+                    "pipeline is gone; restart the attempt")
+        if item is stop:
+            if err:
+                raise err[0]
+            return
+        yield item
 
 
 def device_prefetch(batches: Iterable[Any], mesh, size: int = 2) -> Iterator[Any]:
@@ -60,13 +96,7 @@ def device_prefetch(batches: Iterable[Any], mesh, size: int = 2) -> Iterator[Any
     t = threading.Thread(target=worker, daemon=True)
     t.start()
     try:
-        while True:
-            item = q.get()
-            if item is stop:
-                if err:
-                    raise err[0]
-                return
-            yield item
+        yield from _drain(q, stop, err, t)
     finally:
         cancelled.set()
 

@@ -18,13 +18,32 @@ from __future__ import annotations
 
 import dataclasses
 import glob as globlib
+import logging
 import os
 import struct
-from typing import Iterator, List, Optional, Sequence
+import time
+from typing import Callable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from analytics_zoo_tpu.resilience.errors import ShardReadError
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
 MAGIC = b"AZR1"
+
+
+@dataclasses.dataclass
+class ReadStats:
+    """Skip-and-count bookkeeping for resilient shard reads (the
+    reference's corrupt-image tolerance, surfaced as numbers instead of
+    silence)."""
+
+    records: int = 0           # records successfully yielded
+    retries: int = 0           # transient I/O errors retried
+    skipped_records: int = 0   # undecodable records dropped
+    skipped_shards: int = 0    # whole shards dropped (retry exhaustion /
+    #                            truncation with skip_errors=True)
 
 
 # ---------------------------------------------------------------------------
@@ -54,19 +73,77 @@ class RecordWriter:
         self.close()
 
 
-def read_records(path: str) -> Iterator[bytes]:
-    with open(path, "rb") as f:
-        if f.read(4) != MAGIC:
-            raise ValueError(f"{path}: not an AZR1 record file")
+def read_records(path: str, retries: int = 0, backoff_s: float = 0.05,
+                 stats: Optional[ReadStats] = None,
+                 opener: Callable = open) -> Iterator[bytes]:
+    """Iterate raw payloads of one shard.
+
+    ``retries`` bounds recovery from *transient* I/O errors (flaky NFS/
+    object-store FUSE mounts): the shard is reopened, seeked back to the
+    last good record boundary, and reading continues, with exponential
+    backoff (``backoff_s``, doubling per retry).  When the budget is
+    exhausted, :class:`ShardReadError` is raised with the last cause.
+    ``stats`` (a :class:`ReadStats`) counts yielded records and retries.
+    ``opener`` is the file-open callable (fault-injection seam for tests
+    and the chaos drill)."""
+    state = {"budget": retries, "delay": backoff_s}
+
+    def _transient(e: OSError, what: str) -> None:
+        """Consume one retry (sleep + count) or raise ShardReadError."""
+        if state["budget"] <= 0:
+            raise ShardReadError(
+                f"{path}: {what} failed after {retries} retries: {e}") from e
+        state["budget"] -= 1
+        if stats is not None:
+            stats.retries += 1
+        logger.warning("shard %s: transient error on %s (%s); retrying in "
+                       "%.2fs (%d retries left)", path, what, e,
+                       state["delay"], state["budget"])
+        time.sleep(state["delay"])
+        state["delay"] *= 2
+
+    def _open_at(pos: int):
+        f = opener(path, "rb")
+        try:
+            if f.read(4) != MAGIC:
+                raise ValueError(f"{path}: not an AZR1 record file")
+            if pos > 4:
+                f.seek(pos)
+            return f
+        except Exception:
+            f.close()
+            raise
+
+    offset = 4   # next unread record boundary
+    f = None
+    try:
         while True:
-            head = f.read(4)
-            if len(head) < 4:
-                return
-            (n,) = struct.unpack("<I", head)
-            payload = f.read(n)
+            if f is None:
+                try:
+                    f = _open_at(offset)
+                except OSError as e:
+                    _transient(e, "open")
+                    continue
+            try:
+                head = f.read(4)
+                if len(head) < 4:
+                    return
+                (n,) = struct.unpack("<I", head)
+                payload = f.read(n)
+            except OSError as e:
+                f.close()
+                f = None   # reopen + reseek at the last record boundary
+                _transient(e, f"read at offset {offset}")
+                continue
             if len(payload) < n:
                 raise ValueError(f"{path}: truncated record")
+            offset += 4 + n
+            if stats is not None:
+                stats.records += 1
             yield payload
+    finally:
+        if f is not None:
+            f.close()
 
 
 def shard_paths(pattern: str, shard_index: Optional[int] = None,
@@ -136,7 +213,35 @@ def write_ssd_records(records: Sequence[SSDByteRecord], prefix: str,
     return paths
 
 
-def read_ssd_records(paths: Sequence[str]) -> Iterator[SSDByteRecord]:
+def read_ssd_records(paths: Sequence[str], skip_errors: bool = False,
+                     retries: int = 0, backoff_s: float = 0.05,
+                     stats: Optional[ReadStats] = None,
+                     opener: Callable = open) -> Iterator[SSDByteRecord]:
+    """Decode SSD records across shards, optionally fault-tolerantly.
+
+    ``retries``/``backoff_s`` bound transient I/O recovery per shard (see
+    :func:`read_records`).  With ``skip_errors=True`` the reader follows
+    the reference's corrupt-data policy — skip and count, never abort:
+    an undecodable record is dropped (``stats.skipped_records``); a
+    truncated shard tail or a shard whose retry budget is exhausted drops
+    the REST of that shard (``stats.skipped_shards``) and reading
+    continues with the next shard.  Without it, errors propagate."""
+    stats = stats if stats is not None else ReadStats()
     for p in paths:
-        for payload in read_records(p):
-            yield SSDByteRecord.decode(payload)
+        try:
+            for payload in read_records(p, retries=retries,
+                                        backoff_s=backoff_s, stats=stats,
+                                        opener=opener):
+                try:
+                    yield SSDByteRecord.decode(payload)
+                except (struct.error, ValueError, UnicodeDecodeError) as e:
+                    if not skip_errors:
+                        raise
+                    stats.skipped_records += 1
+                    logger.warning("%s: skipping undecodable record (%s)",
+                                   p, e)
+        except (ShardReadError, ValueError) as e:
+            if not skip_errors:
+                raise
+            stats.skipped_shards += 1
+            logger.warning("%s: skipping rest of shard (%s)", p, e)

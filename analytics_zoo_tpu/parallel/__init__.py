@@ -69,10 +69,21 @@ from analytics_zoo_tpu.parallel.tensor import (
     sharded_param_count,
 )
 from analytics_zoo_tpu.parallel.elastic import (
+    RETRYABLE_ERRORS,
     DivergenceDetector,
     FaultInjector,
     TrainingDiverged,
     run_resilient,
+)
+from analytics_zoo_tpu.resilience import (
+    CheckpointCorrupt,
+    InjectedFault,
+    Preempted,
+    PreemptionHandler,
+    PrefetchWorkerDied,
+    ShardReadError,
+    StallError,
+    StallWatchdog,
 )
 
 __all__ = [k for k in dir() if not k.startswith("_")]

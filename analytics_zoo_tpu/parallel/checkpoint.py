@@ -2,28 +2,158 @@
 ``OptimMethod.load`` snapshot files (reference ``ssd/example/Train.scala:161-163``
 checkpoint path + ``optimizer.setCheckpoint(path, Trigger.everyEpoch)``).
 
-Layout: ``<path>/<step or 'latest'>/`` orbax PyTree checkpoint of the full
-``TrainState`` (params, model_state, opt_state, step, rng).  Multi-host
-safe: orbax coordinates a single logical checkpoint across processes.
+Snapshot lifecycle (hardened — see docs/RESILIENCE.md):
+
+1. orbax writes the pytree into a hidden temp dir (``.tmp_<name>``);
+2. a ``manifest.json`` is written beside it with per-file sha256 +
+   sizes and step/epoch metadata;
+3. the snapshot is *published* with an atomic directory rename — a crash
+   at ANY point before the rename leaves the previous snapshot intact;
+4. ``keep_last=N`` garbage-collects the oldest ``step_N`` snapshots.
+
+Layout: ``<path>/<'latest' or step_N>/{manifest.json, data/<orbax>}``.
+Pre-manifest snapshots (bare orbax dirs) remain loadable.  Restore
+verifies the manifest and, when the newest snapshot is truncated or
+corrupt, automatically falls back to the newest older intact one.
+
+Multi-host safe: orbax coordinates a single logical checkpoint across
+processes; the manifest + publish rename are done by process 0 with a
+cross-process barrier after.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
+import logging
 import os
-from typing import Any, Optional
+import shutil
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+
+from analytics_zoo_tpu.resilience.errors import CheckpointCorrupt
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+MANIFEST = "manifest.json"
+_DATA_SUBDIR = "data"
+
+# Fault-injection hook (chaos drills / tests): ``fn(phase, path)`` called
+# at "pre_save" (before orbax writes), "pre_publish" (snapshot fully
+# written, rename NOT yet done) and "post_publish".  An exception raised
+# at pre_publish simulates a crash mid-save: the temp dir is left behind
+# (cleaned by the next save) and the previous snapshot stays intact.
+_fault_hook: Optional[Callable[[str, str], None]] = None
+
+
+def set_fault_hook(fn: Optional[Callable[[str, str], None]]):
+    """Install (or clear with ``None``) the save-path fault hook.
+    Returns the previous hook so tests can restore it."""
+    global _fault_hook
+    prev, _fault_hook = _fault_hook, fn
+    return prev
+
+
+def _fire(phase: str, path: str) -> None:
+    if _fault_hook is not None:
+        _fault_hook(phase, path)
 
 
 def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
-def save(path: str, state: Any, step: Optional[int] = None) -> str:
-    """Save a pytree (TrainState or raw variables). ``step=None`` overwrites
-    a single 'latest' snapshot (reference ``overWriteCheckpoint``).
+# ---------------------------------------------------------------------------
+# Manifest
+# ---------------------------------------------------------------------------
+
+
+def _sha256(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                return h.hexdigest()
+            h.update(b)
+
+
+def _build_manifest(snap_dir: str, meta: Dict[str, Any]) -> Dict[str, Any]:
+    files: Dict[str, Dict[str, Any]] = {}
+    for root, _dirs, names in os.walk(snap_dir):
+        for n in sorted(names):
+            full = os.path.join(root, n)
+            rel = os.path.relpath(full, snap_dir)
+            if rel == MANIFEST:
+                continue
+            files[rel] = {"size": os.path.getsize(full),
+                          "sha256": _sha256(full)}
+    return {"format": 1, "meta": meta, "files": files}
+
+
+def read_manifest(snap_dir: str) -> Optional[Dict[str, Any]]:
+    """The snapshot's manifest dict, or ``None`` when it has none
+    (pre-manifest layout or partially-written directory)."""
+    p = os.path.join(snap_dir, MANIFEST)
+    if not os.path.isfile(p):
+        return None
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def verify_snapshot(snap_dir: str) -> Dict[str, Any]:
+    """Check every manifest-listed file exists with the recorded size and
+    sha256.  Returns the manifest; raises :class:`CheckpointCorrupt` with
+    the first discrepancy."""
+    man = read_manifest(snap_dir)
+    if man is None:
+        raise CheckpointCorrupt(f"{snap_dir}: manifest missing or unreadable")
+    for rel, info in man.get("files", {}).items():
+        full = os.path.join(snap_dir, rel)
+        if not os.path.isfile(full):
+            raise CheckpointCorrupt(f"{snap_dir}: missing file {rel}")
+        size = os.path.getsize(full)
+        if size != info["size"]:
+            raise CheckpointCorrupt(
+                f"{snap_dir}: {rel} truncated ({size} != {info['size']} bytes)")
+        if _sha256(full) != info["sha256"]:
+            raise CheckpointCorrupt(f"{snap_dir}: {rel} checksum mismatch")
+    return man
+
+
+# ---------------------------------------------------------------------------
+# Save
+# ---------------------------------------------------------------------------
+
+
+def _state_step(host_state: Any) -> Optional[int]:
+    step = getattr(host_state, "step", None)
+    if step is None and isinstance(host_state, dict):
+        step = host_state.get("step")
+    if step is None:
+        return None
+    try:
+        return int(np.asarray(step))
+    except (TypeError, ValueError):
+        return None
+
+
+def save(path: str, state: Any, step: Optional[int] = None,
+         keep_last: Optional[int] = None,
+         meta: Optional[Dict[str, Any]] = None) -> str:
+    """Save a pytree (TrainState or raw variables) atomically.
+
+    ``step=None`` overwrites a single 'latest' snapshot (reference
+    ``overWriteCheckpoint``); an integer publishes ``step_<step>`` and,
+    with ``keep_last=N``, garbage-collects all but the newest N step
+    snapshots.  ``meta`` (e.g. epoch/iteration) is recorded in the
+    manifest beside the train-state step.
 
     Multi-host: EVERY process must call this (orbax's save has internal
     cross-process barriers); replicated leaves are read from the local
@@ -31,41 +161,208 @@ def save(path: str, state: Any, step: Optional[int] = None) -> str:
     from analytics_zoo_tpu.parallel.mesh import host_local_state
 
     name = "latest" if step is None else f"step_{step}"
-    target = os.path.join(os.path.abspath(path), name)
+    base = os.path.abspath(path)
+    target = os.path.join(base, name)
+    os.makedirs(base, exist_ok=True)
+    tmp = os.path.join(base, f".tmp_{name}")
     host_state = host_local_state(state)
-    _checkpointer().save(target, host_state, force=True)
+    _fire("pre_save", target)
+    # stale temps from crashed previous saves: ONE process sweeps them
+    # ALL (step-tagged saves use a fresh .tmp_step_N each time, so a
+    # same-name-only cleanup would leak a snapshot-sized dir per crash),
+    # with a barrier before the collective write — unsynchronized rmtree
+    # on shared storage could delete a peer's in-flight files
+    if jax.process_index() == 0:
+        for d in os.listdir(base):
+            if d.startswith(".tmp_") and os.path.isdir(os.path.join(base, d)):
+                shutil.rmtree(os.path.join(base, d))
+    if jax.process_count() > 1:  # pragma: no cover - multi-host only
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"azr_ckpt_clean_{name}")
+    _checkpointer().save(os.path.join(tmp, _DATA_SUBDIR), host_state,
+                         force=True)
+    if jax.process_index() == 0:
+        man_meta = {"name": name, "step": step,
+                    "state_step": _state_step(host_state)}
+        man_meta.update(meta or {})
+        manifest = _build_manifest(tmp, man_meta)
+        with open(os.path.join(tmp, MANIFEST), "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+        _fire("pre_publish", target)
+        # atomic publish: the old snapshot (if any) moves aside first, so
+        # at no instant does `target` hold a half-written mixture.  The
+        # trash slot is cleared ONLY when a live target needs to move
+        # into it — after a crash between the two renames, trash holds
+        # the sole intact snapshot (a restore candidate) and must
+        # survive until this save actually publishes a replacement.
+        trash = os.path.join(base, f".trash_{name}")
+        if os.path.exists(target):
+            if os.path.isdir(trash):
+                shutil.rmtree(trash)
+            os.rename(target, trash)
+        os.rename(tmp, target)
+        shutil.rmtree(trash, ignore_errors=True)
+        _fire("post_publish", target)
+        if keep_last is not None and step is not None:
+            _gc_old_steps(base, keep_last)
+    if jax.process_count() > 1:  # pragma: no cover - multi-host only
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"azr_ckpt_publish_{name}")
     return target
 
 
-def load(path: str, target: Any = None, step: Optional[int] = None) -> Any:
-    """Restore a checkpoint.  ``target`` (a matching pytree of arrays) fixes
-    leaf types/shapes; without it, raw arrays are returned.
+def _gc_old_steps(base: str, keep_last: int) -> None:
+    steps = _step_dirs(base, require_manifest=False)
+    doomed = steps[:-keep_last] if keep_last > 0 else steps
+    for _n, d in doomed:
+        logger.info("checkpoint GC: removing %s (keep_last=%d)", d, keep_last)
+        shutil.rmtree(d, ignore_errors=True)
 
-    ``step=None`` resolves to the 'latest' overwrite snapshot if present,
-    else the highest ``step_N`` directory, else treats ``path`` itself as
-    the checkpoint directory.
+
+# ---------------------------------------------------------------------------
+# Resolve / load
+# ---------------------------------------------------------------------------
+
+
+def _step_dirs(path: str, require_manifest: bool = True) -> List[Tuple[int, str]]:
+    """``(step, dir)`` pairs ascending by step.  ``require_manifest``
+    skips partially-written ``step_N`` entries (no manifest yet) — they
+    are publish leftovers, never a restore candidate."""
+    out: List[Tuple[int, str]] = []
+    if not os.path.isdir(path):
+        return out
+    for d in os.listdir(path):
+        if not d.startswith("step_"):
+            continue
+        try:
+            n = int(d.split("_", 1)[1])
+        except ValueError:
+            continue
+        full = os.path.join(path, d)
+        if require_manifest and read_manifest(full) is None:
+            logger.warning("checkpoint: skipping %s (no manifest — "
+                           "partially written)", full)
+            continue
+        out.append((n, full))
+    out.sort()
+    return out
+
+
+def latest_step(path: str, require_manifest: bool = True) -> Optional[int]:
+    steps = _step_dirs(path, require_manifest=require_manifest)
+    return steps[-1][0] if steps else None
+
+
+def _recency(snap_dir: str, fallback: float) -> float:
+    """Training-position sort key for a snapshot: manifest iteration,
+    else the state's step counter, else ``fallback``."""
+    man = read_manifest(snap_dir)
+    if man is not None:
+        meta = man.get("meta", {})
+        # loop iteration first (the training position), then the step
+        # tag; state_step last — it reflects the saved pytree's counter,
+        # which raw-variable saves may not advance between snapshots
+        for k in ("iteration", "step", "state_step"):
+            v = meta.get(k)
+            if v is not None:
+                return float(v)
+    return fallback
+
+
+def _candidates(base: str) -> List[str]:
+    """Restore candidates ordered by actual training recency (manifest
+    iteration/step), newest first — NOT by slot name: a stale 'latest'
+    overwrite slot must not outrank newer ``step_N`` snapshots when a
+    job switched checkpointing modes.  ``.trash_*`` dirs come last as a
+    dead-man's fallback — a crash in the tiny window between publish's
+    two renames (old → trash, tmp → target) leaves the displaced-but-
+    intact old snapshot ONLY in trash, and it must stay restorable."""
+    ranked: List[Tuple[float, int, str]] = []
+    latest = os.path.join(base, "latest")
+    if os.path.isdir(latest):
+        # a legacy manifest-less 'latest' keeps its old first-place rank
+        ranked.append((_recency(latest, float("inf")), 1, latest))
+    for n, d in _step_dirs(base, require_manifest=False):
+        ranked.append((_recency(d, float(n)), 0, d))
+    ranked.sort(key=lambda t: (t[0], t[1]), reverse=True)
+    cands = [d for _r, _tie, d in ranked]
+    if os.path.isdir(base):
+        cands.extend(os.path.join(base, d) for d in sorted(os.listdir(base))
+                     if d.startswith(".trash_")
+                     and os.path.isdir(os.path.join(base, d)))
+    return cands
+
+
+def newest_intact(path: str) -> Optional[Tuple[str, Dict[str, Any]]]:
+    """``(snapshot_dir, manifest)`` of the newest snapshot that passes
+    verification, or ``None``.  Used by supervisors/drills to learn where
+    a restart will resume from without restoring the full pytree."""
+    for c in _candidates(os.path.abspath(path)):
+        try:
+            return c, verify_snapshot(c)
+        except CheckpointCorrupt:
+            continue
+    return None
+
+
+def _restore(snap_dir: str, target: Any, verify: bool) -> Any:
+    man = read_manifest(snap_dir)
+    if man is not None:
+        if verify:
+            verify_snapshot(snap_dir)
+        data_dir = os.path.join(snap_dir, _DATA_SUBDIR)
+        if not os.path.isdir(data_dir):
+            data_dir = snap_dir  # manifest written beside a flat snapshot
+    else:
+        data_dir = snap_dir  # pre-manifest layout: bare orbax dir
+    if target is not None:
+        return _checkpointer().restore(data_dir, item=target)
+    return _checkpointer().restore(data_dir)
+
+
+def load(path: str, target: Any = None, step: Optional[int] = None,
+         verify: bool = True) -> Any:
+    """Restore a checkpoint.  ``target`` (a matching pytree of arrays)
+    fixes leaf types/shapes; without it, raw arrays are returned.
+
+    ``step=None`` walks the candidates newest-first ('latest' overwrite
+    slot, then ``step_N`` descending) and returns the first snapshot that
+    verifies AND restores — a truncated/corrupt newest snapshot falls
+    back to the newest intact older one (with a warning) instead of
+    aborting.  ``step=<int>`` pins one snapshot: corruption there raises.
+    ``verify=False`` skips checksum verification (fast path for huge
+    snapshots on trusted storage).
     """
     base = os.path.abspath(path)
     if step is not None:
-        full = os.path.join(base, f"step_{step}")
-    elif os.path.exists(os.path.join(base, "latest")):
-        full = os.path.join(base, "latest")
-    else:
-        newest = latest_step(base)
-        full = os.path.join(base, f"step_{newest}") if newest is not None else base
-    if target is not None:
-        return _checkpointer().restore(full, item=target)
-    return _checkpointer().restore(full)
+        return _restore(os.path.join(base, f"step_{step}"), target, verify)
+    cands = _candidates(base)
+    if not cands:
+        # `path` itself is the snapshot (or a bare orbax dir)
+        return _restore(base, target, verify)
+    errors: List[str] = []
+    for c in cands:
+        try:
+            out = _restore(c, target, verify)
+            if errors:
+                logger.warning("checkpoint: restored fallback %s after "
+                               "rejecting newer snapshot(s): %s", c,
+                               "; ".join(errors))
+            return out
+        except CheckpointCorrupt as e:
+            logger.warning("checkpoint: %s", e)
+            errors.append(str(e))
+        except Exception as e:  # orbax-level failure on an unverified dir
+            logger.warning("checkpoint: restore of %s failed (%s: %s)",
+                           c, type(e).__name__, e)
+            errors.append(f"{c}: {type(e).__name__}: {e}")
+    raise CheckpointCorrupt(
+        f"no intact snapshot under {base}: " + "; ".join(errors))
 
 
-def latest_step(path: str) -> Optional[int]:
-    if not os.path.isdir(path):
-        return None
-    steps = []
-    for d in os.listdir(path):
-        if d.startswith("step_"):
-            try:
-                steps.append(int(d.split("_", 1)[1]))
-            except ValueError:
-                pass
-    return max(steps) if steps else None
+def has_checkpoint(path: str) -> bool:
+    """True when at least one restore candidate exists under ``path``
+    (it may still fail verification — ``load`` handles fallback)."""
+    return bool(_candidates(os.path.abspath(path)))

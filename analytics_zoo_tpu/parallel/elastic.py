@@ -22,7 +22,10 @@ no JAX equivalent, so this module supplies it TPU-natively:
   re-replicates from the restored host-side state.
 
 Fault injection for tests: :class:`FaultInjector` wraps a dataset and
-raises a chosen exception at a chosen global batch index, once.
+raises a chosen exception at a chosen global batch index, once.  The
+full chaos matrix (SIGTERM, mid-save kill, snapshot corruption, stalls,
+transient XLA errors on a schedule) lives in
+:mod:`analytics_zoo_tpu.resilience.chaos`.
 """
 
 from __future__ import annotations
@@ -31,11 +34,24 @@ import logging
 import math
 from typing import Callable, Optional, Sequence, Tuple, Type
 
+from analytics_zoo_tpu.resilience.errors import (
+    InjectedFault,
+    retryable_errors,
+)
+
 logger = logging.getLogger("analytics_zoo_tpu")
 
 
 class TrainingDiverged(RuntimeError):
     """Raised by :class:`DivergenceDetector` after a non-finite loss streak."""
+
+
+#: Failures worth restarting for: preemption, stalls, dead input
+#: pipelines, divergence, injected chaos, and jaxlib device/runtime
+#: errors.  Deliberately NOT ``RuntimeError`` — a bare RuntimeError is
+#: usually a programming error and must propagate on attempt 1.
+RETRYABLE_ERRORS: Tuple[Type[BaseException], ...] = (
+    (TrainingDiverged,) + retryable_errors())
 
 
 class DivergenceDetector:
@@ -74,26 +90,36 @@ def run_resilient(
     build_optimizer: Callable[[], "object"],
     checkpoint_path: str,
     max_restarts: int = 3,
-    retry_on: Tuple[Type[BaseException], ...] = (TrainingDiverged, RuntimeError),
+    retry_on: Optional[Tuple[Type[BaseException], ...]] = None,
     on_restart: Optional[Callable[[int, BaseException], None]] = None,
 ):
     """Supervised training: ``build_optimizer()`` must return a fresh,
     fully-configured :class:`Optimizer` each attempt.  The supervisor
-    forces checkpointing to ``checkpoint_path`` (every epoch, unless the
-    optimizer already configured one) and resume-from-latest, so each
-    restart continues where the last checkpoint left off rather than from
-    scratch.  Returns the trained model.
+    forces checkpointing to ``checkpoint_path`` (every epoch with
+    ``keep_last=3`` step snapshots, unless the optimizer already
+    configured one) and resume-from-latest, so each restart continues
+    where the last checkpoint left off rather than from scratch.
+    Returns the trained model.
 
-    ``retry_on`` filters which failures are retryable — programming errors
-    (TypeError, ValueError...) propagate immediately by default.
+    ``retry_on`` filters which failures are retryable; it defaults to
+    :data:`RETRYABLE_ERRORS` (preemption, stalls, divergence, device/
+    runtime errors).  Programming errors — ``TypeError``, ``ValueError``,
+    and notably *bare* ``RuntimeError`` — propagate on attempt 1 so real
+    bugs are never masked by restart churn.
     """
     from analytics_zoo_tpu.parallel.optim import Trigger
 
+    if retry_on is None:
+        retry_on = RETRYABLE_ERRORS
     attempt = 0
     while True:
         opt = build_optimizer()
         if opt.checkpoint_trigger is None:
-            opt.set_checkpoint(checkpoint_path, Trigger.every_epoch())
+            # step-tagged snapshots (not the single overwrite slot): a
+            # corrupted newest snapshot can then fall back to an older
+            # intact one instead of losing the run
+            opt.set_checkpoint(checkpoint_path, Trigger.every_epoch(),
+                               overwrite=False, keep_last=3)
         # resume from wherever checkpoints actually land — the optimizer
         # may have configured its own path different from the supervisor's
         opt.set_resume(opt.checkpoint_path)
@@ -114,13 +140,16 @@ def run_resilient(
 class FaultInjector:
     """Dataset wrapper that raises ``exc`` just before yielding global
     batch index ``fail_at`` (counted across epochs), exactly once —
-    simulating a mid-training device loss / preemption for tests."""
+    simulating a mid-training device loss / preemption for tests.  The
+    default exception is :class:`InjectedFault` (retryable); pass a bare
+    ``ValueError``/``RuntimeError`` to simulate a genuine bug instead.
+    For multi-fault schedules use ``resilience.chaos.ChaosMonkey``."""
 
     def __init__(self, dataset, fail_at: int,
                  exc: Optional[BaseException] = None):
         self.dataset = dataset
         self.fail_at = fail_at
-        self.exc = exc or RuntimeError("injected fault")
+        self.exc = exc or InjectedFault("injected fault")
         self._count = 0
         self._fired = False
 

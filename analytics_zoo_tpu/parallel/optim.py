@@ -239,6 +239,24 @@ class Trigger:
 
     # -- factories ---------------------------------------------------------
     @staticmethod
+    def always() -> "Trigger":
+        """Fires at every evaluation (per-iteration checkpointing in
+        chaos drills / debugging — expensive for real jobs)."""
+        return Trigger(lambda s: True, "always")
+
+    @staticmethod
+    def max_wall_time(seconds: float) -> "Trigger":
+        """Fires once ``seconds`` of wall time elapsed since the trigger
+        was CREATED (host-side clock).  The bounded-run guard for drills
+        and preemptible jobs: compose as ``Trigger.or_(max_epoch(n),
+        max_wall_time(t))`` so a restart-looping run still terminates."""
+        import time as _time
+
+        start = _time.monotonic()
+        return Trigger(lambda s: _time.monotonic() - start >= seconds,
+                       f"maxWallTime({seconds}s)")
+
+    @staticmethod
     def every_epoch() -> "Trigger":
         return Trigger(lambda s: s.epoch_finished, "everyEpoch")
 

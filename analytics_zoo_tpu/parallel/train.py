@@ -451,6 +451,9 @@ class Optimizer:
         self.resume_path: Optional[str] = None
         self._resume_requested = False
         self.failure_detector = None
+        self.preemption_handler = None
+        self.stall_watchdog = None
+        self.checkpoint_keep_last: Optional[int] = None
         self.epoch_hook = None
         self._skip_batches = 0      # mid-epoch resume fast-forward
         self._iter_in_epoch = 0
@@ -474,10 +477,36 @@ class Optimizer:
         return self
 
     def set_checkpoint(self, path: str, trigger: Trigger,
-                       overwrite: bool = True) -> "Optimizer":
+                       overwrite: bool = True,
+                       keep_last: Optional[int] = None) -> "Optimizer":
+        """``overwrite=True`` keeps one 'latest' snapshot; ``False``
+        publishes ``step_N`` snapshots, with ``keep_last=N`` retention GC
+        (older snapshots are fallbacks when the newest is corrupt)."""
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger
         self.overwrite_checkpoint = overwrite
+        self.checkpoint_keep_last = keep_last
+        return self
+
+    def set_preemption_handler(self, handler=None) -> "Optimizer":
+        """Trap SIGTERM/SIGINT during ``optimize()``: the loop finishes
+        the in-flight step, takes a forced checkpoint at the boundary,
+        and raises a retryable ``Preempted`` (see docs/RESILIENCE.md)."""
+        from analytics_zoo_tpu.resilience.preempt import PreemptionHandler
+        self.preemption_handler = handler or PreemptionHandler()
+        return self
+
+    def set_stall_watchdog(self, watchdog) -> "Optimizer":
+        """Raise ``StallError`` (instead of hanging forever) when the
+        loop makes no progress within a deadline.  Pass a
+        ``StallWatchdog`` or a float timeout in seconds; the heartbeat is
+        per-phase (step / validation / checkpoint save), so size it to
+        cover the slowest SINGLE legitimate phase — including the
+        first-step XLA compile and the full snapshot write."""
+        from analytics_zoo_tpu.resilience.watchdog import StallWatchdog
+        if not hasattr(watchdog, "beat"):
+            watchdog = StallWatchdog(float(watchdog))
+        self.stall_watchdog = watchdog
         return self
 
     def set_resume(self, path: Optional[str] = None) -> "Optimizer":
@@ -538,75 +567,92 @@ class Optimizer:
                                    compute_dtype=self.compute_dtype)
         if self.prefetch:
             from analytics_zoo_tpu.data.prefetch import device_prefetch
+        ph = self.preemption_handler
+        wd = self.stall_watchdog
+        if ph is not None:
+            ph.stall_watchdog = wd   # stall interrupts beat preemption
+            ph.install()
+        if wd is not None:
+            wd.start()
         t_epoch = time.time()
         records = 0
         stop = False
         sentinel = object()
-        while not stop and not self.end_when(loop):
-            loop.epoch_finished = False
-            host_iter = iter(self.dataset)
-            # mid-epoch resume: fast-forward past already-trained batches
-            # ON THE HOST — never shard/transfer data that will be dropped
-            while self._skip_batches > 0:
-                if next(host_iter, sentinel) is sentinel:
-                    break
-                self._skip_batches -= 1
-                self._iter_in_epoch += 1
-            epoch_batches = (device_prefetch(host_iter, self.mesh,
-                                             self.prefetch)
-                             if self.prefetch else host_iter)
-            try:
-                for batch in epoch_batches:
-                    n = _batch_size(batch)
-                    dev_batch = (batch if self.prefetch
-                                 else mesh_lib.shard_batch(
-                                     batch, self.mesh,
-                                     overrides=self.batch_overrides))
-                    # device_transform is fused INSIDE train_step
-                    state, metrics = train_step(state, dev_batch,
-                                                self.optim.lr_scale)
-                    loop.iteration += 1
-                    self._iter_in_epoch += 1
-                    records += n
-                    if (self.failure_detector is not None
-                            and self.failure_detector.should_check(
-                                loop.iteration)):
-                        self.failure_detector.check(float(metrics["loss"]),
-                                                    loop.iteration)
-                    # keep the loss as a device array — only force a host
-                    # sync when something host-side actually reads it
-                    loop.loss = metrics["loss"]
-                    if self.train_summary is not None:
-                        # device arrays on purpose: add_scalar floats them
-                        # only when the tag's trigger fires
-                        self.train_summary.add_scalar(
-                            "Loss", metrics["loss"], loop.iteration)
-                        self.train_summary.add_scalar(
-                            "LearningRate", metrics["lr"], loop.iteration)
-                    self._maybe_validate(loop, state, eval_step)
-                    self._maybe_checkpoint(loop, state)
-                    if self.end_when(loop):
-                        stop = True
+        try:
+            while not stop and not self.end_when(loop):
+                loop.epoch_finished = False
+                host_iter = iter(self.dataset)
+                # mid-epoch resume: fast-forward past already-trained batches
+                # ON THE HOST — never shard/transfer data that will be dropped
+                while self._skip_batches > 0:
+                    if next(host_iter, sentinel) is sentinel:
                         break
-            finally:
-                # early exit (end_when break / detector raise): release
-                # the prefetch worker and its HBM-pinned queued batches
-                if hasattr(epoch_batches, "close"):
-                    epoch_batches.close()
-            if stop:
-                break  # partial epoch: don't count or re-trigger it
-            loop.epoch += 1
-            loop.epoch_finished = True
-            self._iter_in_epoch = 0
-            loop.loss = float(loop.loss)
-            dt = time.time() - t_epoch
-            logger.info("Epoch %d done: %d records in %.1fs (%.1f records/s), loss %.4f",
-                        loop.epoch, records, dt, records / max(dt, 1e-9), loop.loss)
-            t_epoch, records = time.time(), 0
-            self._maybe_validate(loop, state, eval_step)
-            self._maybe_checkpoint(loop, state)
-            if self.epoch_hook is not None:
-                self.epoch_hook(loop, state)
+                    self._skip_batches -= 1
+                    self._iter_in_epoch += 1
+                epoch_batches = (device_prefetch(host_iter, self.mesh,
+                                                 self.prefetch)
+                                 if self.prefetch else host_iter)
+                try:
+                    for batch in epoch_batches:
+                        n = _batch_size(batch)
+                        dev_batch = (batch if self.prefetch
+                                     else mesh_lib.shard_batch(
+                                         batch, self.mesh,
+                                         overrides=self.batch_overrides))
+                        # device_transform is fused INSIDE train_step
+                        state, metrics = train_step(state, dev_batch,
+                                                    self.optim.lr_scale)
+                        loop.iteration += 1
+                        self._iter_in_epoch += 1
+                        records += n
+                        if (self.failure_detector is not None
+                                and self.failure_detector.should_check(
+                                    loop.iteration)):
+                            self.failure_detector.check(float(metrics["loss"]),
+                                                        loop.iteration)
+                        # keep the loss as a device array — only force a host
+                        # sync when something host-side actually reads it
+                        loop.loss = metrics["loss"]
+                        if self.train_summary is not None:
+                            # device arrays on purpose: add_scalar floats them
+                            # only when the tag's trigger fires
+                            self.train_summary.add_scalar(
+                                "Loss", metrics["loss"], loop.iteration)
+                            self.train_summary.add_scalar(
+                                "LearningRate", metrics["lr"], loop.iteration)
+                        self._boundary_checks(loop, state, eval_step,
+                                              wd, ph)
+                        if self.end_when(loop):
+                            stop = True
+                            break
+                finally:
+                    # early exit (end_when break / detector raise): release
+                    # the prefetch worker and its HBM-pinned queued batches
+                    if hasattr(epoch_batches, "close"):
+                        epoch_batches.close()
+                if stop:
+                    break  # partial epoch: don't count or re-trigger it
+                loop.epoch += 1
+                loop.epoch_finished = True
+                self._iter_in_epoch = 0
+                loop.loss = float(loop.loss)
+                dt = time.time() - t_epoch
+                logger.info("Epoch %d done: %d records in %.1fs (%.1f records/s), loss %.4f",
+                            loop.epoch, records, dt, records / max(dt, 1e-9), loop.loss)
+                t_epoch, records = time.time(), 0
+                self._boundary_checks(loop, state, eval_step, wd, ph)
+                if self.epoch_hook is not None:
+                    self.epoch_hook(loop, state)
+        except KeyboardInterrupt:
+            # the stall watchdog signals via a main-thread interrupt; a
+            # REAL Ctrl-C (watchdog quiet) keeps its usual meaning
+            self._raise_if_stalled(wd, loop)
+            raise
+        finally:
+            if wd is not None:
+                wd.stop()
+            if ph is not None:
+                ph.uninstall()
         # write trained variables back into the model wrapper (local-
         # replica read: safe on a mesh spanning processes)
         host_state = mesh_lib.host_local_state(state)
@@ -636,12 +682,97 @@ class Optimizer:
             loop.score = metrics[self._score_name]
             self.optim.on_validation({"score": loop.score, **metrics})
 
-    def _maybe_checkpoint(self, loop: TrainingState, state: TrainState):
-        if self.checkpoint_trigger is None or not self.checkpoint_trigger(loop):
+    def _boundary_checks(self, loop: TrainingState, state: TrainState,
+                         eval_step, wd, ph) -> None:
+        """Everything that runs at a step/epoch boundary, in order:
+        validation, checkpoint, stall classification, preemption.  Kept
+        in ONE place so step and epoch boundaries cannot drift apart.
+
+        Per-phase heartbeats: the step, the validation pass, and the
+        (sha256-hashed) checkpoint save each get their own deadline
+        window — size the watchdog for the slowest SINGLE phase.  Stall
+        beats preempt: the watchdog's interrupt may have been absorbed
+        by the signal handler as a preempt request, so it must be
+        re-classified before the preemption check."""
+        if wd is not None:
+            wd.beat()
+        self._maybe_validate(loop, state, eval_step)
+        if wd is not None:
+            wd.beat()
+        self._maybe_checkpoint(loop, state)
+        self._raise_if_stalled(wd, loop)
+        if wd is not None:
+            wd.beat()
+        if ph is not None and self._preempt_agreed(ph, loop):
+            self._graceful_preempt(loop, state)
+
+    def _raise_if_stalled(self, wd, loop: TrainingState) -> None:
+        if wd is None or not wd.stalled:
             return
+        from analytics_zoo_tpu.resilience.errors import StallError
+
+        # absorb the watchdog's simulated SIGINT if it is still pending
+        # (the monitor sets `stalled` a moment before interrupt_main; a
+        # boundary check landing in that window would otherwise leave a
+        # stray KeyboardInterrupt to pop in unrelated code later)
+        try:
+            time.sleep(0.2)
+        except KeyboardInterrupt:
+            pass
+        raise StallError(
+            f"no training progress past the {wd.timeout_s:.1f}s stall "
+            f"deadline at iteration {loop.iteration}")
+
+    #: multi-host boundaries between preemption-agreement collectives —
+    #: bounds the graceful-response latency to this many steps while
+    #: keeping the per-step hot path free of cross-host syncs
+    preempt_sync_every: int = 16
+
+    def _preempt_agreed(self, ph, loop: TrainingState) -> bool:
+        """Whether to act on a preemption request at this boundary.
+        Multi-host: the request flags are OR-reduced across hosts — a
+        signal landing on ANY process (single-pod eviction, per-host
+        OOM-kill) makes EVERY process enter the forced final checkpoint,
+        which is a COLLECTIVE save, at the same step boundary.  The
+        agreement gather is itself a cross-host sync, so it runs only
+        every ``preempt_sync_every`` iterations (a replicated,
+        deterministic schedule), not on every step."""
+        if jax.process_count() == 1:
+            return ph.requested
+        if loop.iteration % max(self.preempt_sync_every, 1):
+            return False  # pragma: no cover - multi-host only
+        from jax.experimental import multihost_utils  # pragma: no cover
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([ph.requested]))  # pragma: no cover
+        return bool(np.any(flags))  # pragma: no cover
+
+    def _graceful_preempt(self, loop: TrainingState, state: TrainState):
+        """Step-boundary response to SIGTERM/SIGINT: force a final
+        checkpoint, then raise the retryable ``Preempted`` so a
+        supervisor (or the job's next incarnation) resumes from it."""
+        from analytics_zoo_tpu.resilience.errors import Preempted
+
+        saved = False
+        if self.checkpoint_path is not None:
+            saved = bool(self._maybe_checkpoint(loop, state, force=True))
+        raise Preempted(
+            f"preemption signal received at iteration {loop.iteration}; "
+            + ("final checkpoint written"
+               if saved else
+               "NO final checkpoint written (no path configured, already "
+               "saved this iteration, or loss non-finite) — resume falls "
+               "back to the previous snapshot"))
+
+    def _maybe_checkpoint(self, loop: TrainingState, state: TrainState,
+                          force: bool = False) -> bool:
+        """Returns True when this iteration's state is persisted (saved
+        now, or already saved at this very iteration)."""
+        if not force and (self.checkpoint_trigger is None
+                          or not self.checkpoint_trigger(loop)):
+            return False
         if getattr(self, "_last_ckpt_iter", None) == loop.iteration:
-            return
-        self._last_ckpt_iter = loop.iteration
+            return True
         # never snapshot a poisoned state: a non-finite loss means the
         # params may already be NaN, and overwriting 'latest' with them
         # would make every elastic restart resume the divergence
@@ -649,56 +780,62 @@ class Optimizer:
         if not np.isfinite(loss_now):
             logger.warning("skipping checkpoint at iteration %d: "
                            "loss is %s", loop.iteration, loss_now)
-            return
-        import json
-
+            return False
+        # memoized only on an ACTUAL save: a skipped save must not make a
+        # later forced call at this iteration report "already persisted"
+        self._last_ckpt_iter = loop.iteration
         from analytics_zoo_tpu.parallel import checkpoint as ckpt
         tag = None if self.overwrite_checkpoint else loop.iteration
         # multi-host: EVERY process calls save (orbax has internal
         # cross-process barriers and elects the writer itself); the
         # trigger decision above is deterministic and replicated, so all
-        # processes reach this point together
-        ckpt.save(self.checkpoint_path, state, step=tag)
-        if jax.process_index() != 0:
-            return
-        # loop-position + host-optim sidecar so resume restores
-        # epoch/iteration/in-epoch position and Plateau's learned LR state
-        # (the TrainState only carries the step counter).  Written via
-        # temp-file + rename so a crash between the orbax save and this
-        # write can't pair new params with stale metadata.  One writer:
-        # process 0 (plain host I/O, no collective to stay in step with).
-        meta = {"epoch": loop.epoch, "iteration": loop.iteration,
-                "iter_in_epoch": self._iter_in_epoch,
-                "optim": self.optim.state_dict()}
-        base = os.path.abspath(self.checkpoint_path)
-        tmp = os.path.join(base, ".loop_meta.json.tmp")
-        with open(tmp, "w") as f:
-            json.dump(meta, f)
-        os.replace(tmp, os.path.join(base, "loop_meta.json"))
+        # processes reach this point together.  Loop position + host-side
+        # optim state (Plateau's learned LR scale) ride in the snapshot's
+        # own manifest, so a restore can never pair params with metadata
+        # from a DIFFERENT snapshot.
+        ckpt.save(self.checkpoint_path, state, step=tag,
+                  keep_last=self.checkpoint_keep_last,
+                  meta={"epoch": loop.epoch, "iteration": loop.iteration,
+                        "iter_in_epoch": self._iter_in_epoch,
+                        "optim": self.optim.state_dict()})
+        return True
+
+    def _apply_resume_meta(self, meta, loop: TrainingState, state) -> None:
+        loop.epoch = int(meta.get("epoch", 0))
+        loop.iteration = int(meta.get("iteration", int(state.step)))
+        self._skip_batches = int(meta.get("iter_in_epoch", 0))
+        self.optim.load_state_dict(meta.get("optim", {}) or {})
 
     def _try_resume(self, base: str, state: TrainState, loop: TrainingState):
-        """Restore (state, loop, host optim state) from the latest
+        """Restore (state, loop, host optim state) from the newest INTACT
         checkpoint under ``base`` if one exists; otherwise return the
-        fresh pair unchanged."""
+        fresh pair unchanged.  A corrupt/truncated newest snapshot falls
+        back to the next older intact one — loop metadata comes from the
+        restored snapshot's own manifest, so position and params always
+        match."""
         import json
 
         from analytics_zoo_tpu.parallel import checkpoint as ckpt
         base = os.path.abspath(base)
-        has_ckpt = (os.path.exists(os.path.join(base, "latest"))
-                    or ckpt.latest_step(base) is not None)
-        if not has_ckpt:
+        if not ckpt.has_checkpoint(base):
             return state, loop
-        state = ckpt.load(base, target=state)
-        meta_path = os.path.join(base, "loop_meta.json")
-        if os.path.exists(meta_path):
-            with open(meta_path) as f:
-                meta = json.load(f)
-            loop.epoch = int(meta.get("epoch", 0))
-            loop.iteration = int(meta.get("iteration", int(state.step)))
-            self._skip_batches = int(meta.get("iter_in_epoch", 0))
-            self.optim.load_state_dict(meta.get("optim", {}))
+        found = ckpt.newest_intact(base)
+        if found is not None:
+            snap_dir, manifest = found
+            # newest_intact already checksummed this exact dir — do not
+            # pay a second full read+sha256 pass on the restart hot path
+            state = ckpt.load(snap_dir, target=state, verify=False)
+            self._apply_resume_meta(manifest.get("meta", {}), loop, state)
         else:
-            loop.iteration = int(state.step)
+            # legacy layout (pre-manifest snapshots): best-effort restore
+            # with the loop_meta.json sidecar older builds wrote
+            state = ckpt.load(base, target=state)
+            meta_path = os.path.join(base, "loop_meta.json")
+            if os.path.exists(meta_path):
+                with open(meta_path) as f:
+                    self._apply_resume_meta(json.load(f), loop, state)
+            else:
+                loop.iteration = int(state.step)
         logger.info("resumed from %s at epoch %d, iteration %d "
                     "(skipping %d in-epoch batches)",
                     base, loop.epoch, loop.iteration, self._skip_batches)

@@ -1,0 +1,36 @@
+"""Resilience layer: failure taxonomy, stall detection, graceful
+preemption, and chaos fault injection.
+
+The reference delegated its whole failure story to Spark task retry and
+lineage (``ssd/example/Train.scala:153``); a TPU-native system owns it
+itself.  The pieces (see docs/RESILIENCE.md):
+
+- :mod:`errors` — retryable vs fatal taxonomy (:func:`retryable_errors`)
+- :mod:`watchdog` — :class:`StallWatchdog` (hung step → StallError)
+- :mod:`preempt` — :class:`PreemptionHandler` (SIGTERM → checkpoint →
+  Preempted)
+- :mod:`chaos` — :class:`ChaosMonkey` fault matrix + ``tools/chaos_drill``
+- atomic/verified snapshots live in :mod:`analytics_zoo_tpu.parallel.
+  checkpoint`; the restart supervisor in :mod:`analytics_zoo_tpu.
+  parallel.elastic`.
+"""
+
+from analytics_zoo_tpu.resilience.errors import (
+    CheckpointCorrupt,
+    InjectedFault,
+    Preempted,
+    PrefetchWorkerDied,
+    ShardReadError,
+    StallError,
+    retryable_errors,
+)
+from analytics_zoo_tpu.resilience.watchdog import StallWatchdog
+from analytics_zoo_tpu.resilience.preempt import PreemptionHandler
+from analytics_zoo_tpu.resilience.chaos import (
+    ChaosMonkey,
+    FaultSpec,
+    corrupt_snapshot,
+    transient_xla_error,
+)
+
+__all__ = [k for k in dir() if not k.startswith("_")]

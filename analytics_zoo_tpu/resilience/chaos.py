@@ -1,0 +1,247 @@
+"""Chaos fault-injection matrix — grow-up of ``parallel.elastic.FaultInjector``.
+
+Where :class:`~analytics_zoo_tpu.parallel.elastic.FaultInjector` raises a
+single exception once, :class:`ChaosMonkey` drives a whole *schedule* of
+heterogeneous faults against a running training job, each at a chosen
+global batch index:
+
+===================  ======================================================
+kind                 effect
+===================  ======================================================
+``crash``            raise :class:`InjectedFault` (generic lost task)
+``xla_transient``    raise ``jaxlib...XlaRuntimeError`` (device/runtime
+                     error — what a real TPU relay drop surfaces as)
+``sigterm``          deliver SIGTERM to this process (graceful-preemption
+                     path: checkpoint at the boundary, ``Preempted``)
+``mid_save_kill``    arm a one-shot hook that crashes the NEXT checkpoint
+                     save after the snapshot is written but BEFORE the
+                     atomic publish rename (crash mid-save)
+``corrupt_latest``   truncate a manifest-listed file of the newest intact
+                     snapshot on disk (restore must fall back)
+``stall``            sleep past the StallWatchdog deadline (hung step)
+===================  ======================================================
+
+The schedule is plain data (:class:`FaultSpec` list), so drills can build
+it from a seeded RNG and stay deterministic.  The monkey's batch counter
+is *global across epochs and restart attempts* — wrap the dataset once,
+reuse the wrapper in every rebuilt Optimizer, and each fault fires
+exactly once per schedule entry.
+
+Used by ``tools/chaos_drill.py`` (committed artifact RESILIENCE_r01.json)
+and the tier-1 chaos-matrix tests in ``tests/test_elastic.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal as _signal
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from analytics_zoo_tpu.resilience.errors import InjectedFault
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+KINDS = ("crash", "xla_transient", "sigterm", "mid_save_kill",
+         "corrupt_latest", "stall")
+
+
+def transient_xla_error(msg: str = "injected transient device error"):
+    """An exception of the real jaxlib runtime-error type when available
+    (so the retry filter is exercised against the genuine class)."""
+    try:
+        import jaxlib.xla_extension as xe
+
+        return xe.XlaRuntimeError(msg)
+    except Exception:  # pragma: no cover - jaxlib always present in-image
+        return InjectedFault(msg)
+
+
+def corrupt_snapshot(checkpoint_path: str) -> Tuple[str, str]:
+    """Truncate the largest manifest-listed file of the newest intact
+    snapshot under ``checkpoint_path`` to half its size.  Returns
+    ``(snapshot_dir, relative_file)``.  Raises ``FileNotFoundError``
+    when no intact snapshot exists to corrupt."""
+    from analytics_zoo_tpu.parallel import checkpoint as ckpt
+
+    found = ckpt.newest_intact(checkpoint_path)
+    if found is None:
+        raise FileNotFoundError(
+            f"no intact snapshot under {checkpoint_path} to corrupt")
+    snap_dir, man = found
+    files = man.get("files", {})
+    if not files:
+        raise FileNotFoundError(f"{snap_dir}: manifest lists no files")
+    rel = max(files, key=lambda r: files[r]["size"])
+    full = os.path.join(snap_dir, rel)
+    size = os.path.getsize(full)
+    with open(full, "r+b") as f:
+        f.truncate(max(size // 2, 1))
+    logger.warning("chaos: truncated %s (%d -> %d bytes)", full, size,
+                   os.path.getsize(full))
+    return snap_dir, rel
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One scheduled fault: ``kind`` fires just before the wrapped
+    dataset yields global batch index ``at_batch`` (counted across epochs
+    AND restart attempts)."""
+
+    kind: str
+    at_batch: int
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"one of {KINDS}")
+
+
+class ChaosMonkey:
+    """Executes a :class:`FaultSpec` schedule against a training job.
+
+    ``checkpoint_path`` is required for the ``mid_save_kill`` and
+    ``corrupt_latest`` kinds.  ``stall_s`` sizes the injected hang (must
+    exceed the job's StallWatchdog deadline to trigger it).  Every fired
+    fault is appended to :attr:`events` (plain dicts, no wall-clock — so
+    drill artifacts stay deterministic).
+    """
+
+    def __init__(self, faults: Sequence[FaultSpec],
+                 checkpoint_path: Optional[str] = None,
+                 stall_s: float = 1.0):
+        self.faults = sorted(faults, key=lambda f: f.at_batch)
+        self.checkpoint_path = checkpoint_path
+        self.stall_s = stall_s
+        self.events: List[Dict[str, Any]] = []
+        self.consumed = 0          # global batch counter
+        self._fired = [False] * len(self.faults)
+        self._armed_hook = None    # mid_save_kill hook awaiting a save
+
+    # -- dataset hook ------------------------------------------------------
+    def dataset(self, ds) -> "ChaosDataset":
+        """Wrap ``ds`` so faults fire at their scheduled batch indices.
+        The wrapper is re-iterable (one fresh pass over ``ds`` per epoch)
+        while the fault schedule and counter stay with the monkey."""
+        return ChaosDataset(self, ds)
+
+    def _due(self) -> List[int]:
+        return [i for i, f in enumerate(self.faults)
+                if not self._fired[i] and f.at_batch <= self.consumed]
+
+    def on_batch(self) -> None:
+        """Fire every due fault (called by the wrapper before each yield).
+        Raising kinds record first, then raise."""
+        for i in self._due():
+            self._fired[i] = True
+            f = self.faults[i]
+            logger.warning("chaos: firing %s at batch %d", f.kind,
+                           self.consumed)
+            getattr(self, f"_fire_{f.kind}")(f, i)
+
+    def _record(self, f: FaultSpec, **detail) -> None:
+        self.events.append({"kind": f.kind, "at_batch": self.consumed,
+                            **detail})
+
+    # -- fault kinds -------------------------------------------------------
+    def _fire_crash(self, f: FaultSpec, i: int) -> None:
+        self._record(f)
+        raise InjectedFault(f"injected crash at batch {self.consumed}")
+
+    def _fire_xla_transient(self, f: FaultSpec, i: int) -> None:
+        self._record(f)
+        raise transient_xla_error(
+            f"injected transient device error at batch {self.consumed}")
+
+    def _fire_sigterm(self, f: FaultSpec, i: int) -> None:
+        self._record(f)
+        os.kill(os.getpid(), _signal.SIGTERM)
+
+    def _fire_stall(self, f: FaultSpec, i: int) -> None:
+        self._record(f, stall_s=self.stall_s)
+        time.sleep(self.stall_s)
+
+    def _fire_mid_save_kill(self, f: FaultSpec, i: int) -> None:
+        from analytics_zoo_tpu.parallel import checkpoint as ckpt
+
+        if self.checkpoint_path is None:
+            raise ValueError("mid_save_kill needs ChaosMonkey("
+                             "checkpoint_path=...) — an unscoped hook "
+                             "could detonate in an unrelated job's save")
+        armed_at = self.consumed
+        scope = os.path.abspath(self.checkpoint_path)
+
+        def hook(phase: str, path: str) -> None:
+            if phase != "pre_publish":
+                return
+            # scoped to this monkey's checkpoint tree: an armed hook
+            # must never detonate inside an unrelated job's save
+            if not os.path.abspath(path).startswith(scope + os.sep):
+                return
+            ckpt.set_fault_hook(None)  # one-shot
+            self._armed_hook = None
+            self.events.append({"kind": "mid_save_kill",
+                                "armed_at_batch": armed_at,
+                                "fired_in_save": os.path.basename(path)})
+            raise InjectedFault(
+                f"injected crash mid-save of {path} (before publish)")
+
+        self._armed_hook = hook
+        ckpt.set_fault_hook(hook)
+
+    def _fire_corrupt_latest(self, f: FaultSpec, i: int) -> None:
+        if self.checkpoint_path is None:
+            raise ValueError("corrupt_latest needs ChaosMonkey("
+                             "checkpoint_path=...)")
+        try:
+            snap, rel = corrupt_snapshot(self.checkpoint_path)
+            self._record(f, snapshot=os.path.basename(snap), file=rel)
+        except FileNotFoundError:
+            # nothing on disk yet — re-arm one batch later
+            self._fired[i] = False
+            self.faults[i] = FaultSpec(f.kind, f.at_batch + 1)
+
+    def disarm(self) -> None:
+        """Clear a still-armed ``mid_save_kill`` hook.  The hook is a
+        process-global on the checkpoint module; call this when the
+        drill/test ends (whether or not a save ever reached it) so no
+        armed fault leaks into a later job in the same process."""
+        from analytics_zoo_tpu.parallel import checkpoint as ckpt
+
+        if self._armed_hook is not None:
+            prev = ckpt.set_fault_hook(None)
+            if prev is not None and prev is not self._armed_hook:
+                ckpt.set_fault_hook(prev)   # not ours — put it back
+            self._armed_hook = None
+
+    def __enter__(self) -> "ChaosMonkey":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.disarm()
+
+    # -- reporting ---------------------------------------------------------
+    def fired_kinds(self) -> List[str]:
+        return sorted({e["kind"] for e in self.events})
+
+    def all_fired(self) -> bool:
+        return all(self._fired)
+
+
+class ChaosDataset:
+    """Re-iterable dataset wrapper bound to a :class:`ChaosMonkey`."""
+
+    def __init__(self, monkey: ChaosMonkey, ds):
+        self.monkey = monkey
+        self.ds = ds
+
+    def __iter__(self):
+        for batch in self.ds:
+            self.monkey.on_batch()
+            self.monkey.consumed += 1
+            yield batch
+
+    def __len__(self):
+        return len(self.ds)

@@ -1,0 +1,81 @@
+"""Failure taxonomy for the resilience layer.
+
+Every error class here is dependency-free on purpose: the data layer
+(``data/prefetch.py``, ``data/records.py``), the checkpoint layer
+(``parallel/checkpoint.py``) and the supervisor (``parallel/elastic.py``)
+all import from this module, so it must sit at the bottom of the import
+graph.
+
+The split that matters operationally is *retryable* vs *fatal*:
+
+- retryable — the program was correct but the world failed under it
+  (device lost, host preempted, a step hung, a worker thread died).  The
+  :func:`~analytics_zoo_tpu.parallel.elastic.run_resilient` supervisor
+  rebuilds and resumes from the newest intact checkpoint.
+- fatal — a programming or configuration error (``TypeError``,
+  ``ValueError``, shape mismatches).  Restarting cannot fix these; they
+  propagate on the first attempt so the bug surfaces immediately.
+
+``retryable_errors()`` assembles the canonical retryable tuple, pulling
+in the jaxlib runtime error type when available (transient XLA/device
+errors — the TPU-native analogue of a lost Spark executor).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Type
+
+
+class Preempted(RuntimeError):
+    """The host received SIGTERM/SIGINT mid-training; a graceful final
+    checkpoint was taken at the step boundary before raising.  Retryable:
+    a supervisor (or the next scheduled incarnation of this job) resumes
+    from that checkpoint."""
+
+
+class StallError(RuntimeError):
+    """A train step or data fetch made no progress past the
+    :class:`~analytics_zoo_tpu.resilience.watchdog.StallWatchdog`
+    deadline.  Raised *instead of hanging forever* — a hung device call
+    or dead input pipeline otherwise blocks the host loop silently."""
+
+
+class PrefetchWorkerDied(RuntimeError):
+    """The prefetch worker thread died without enqueueing its stop
+    sentinel — the consumer would previously block on ``q.get()``
+    forever.  Retryable: a fresh attempt restarts the worker."""
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A snapshot failed manifest verification (missing manifest, missing
+    file, size or checksum mismatch) and no older intact snapshot could
+    be restored in its place."""
+
+
+class ShardReadError(IOError):
+    """A data-shard read kept failing after the bounded retry/backoff
+    budget was exhausted.  Persistent (not transient) by definition —
+    NOT retryable via restart; use ``skip_errors=True`` in the record
+    reader to skip-and-count the shard instead."""
+
+
+class InjectedFault(RuntimeError):
+    """Default exception for chaos/fault injection — stands in for a
+    lost device or killed task, so it counts as retryable."""
+
+
+def retryable_errors() -> Tuple[Type[BaseException], ...]:
+    """The canonical tuple of transient, restart-recoverable failures."""
+    errs: Tuple[Type[BaseException], ...] = (
+        Preempted,
+        StallError,
+        PrefetchWorkerDied,
+        InjectedFault,
+    )
+    try:  # transient device/runtime errors (lost TPU, relay drop, OOM)
+        import jaxlib.xla_extension as _xe
+
+        errs = errs + (_xe.XlaRuntimeError,)
+    except Exception:  # pragma: no cover - jaxlib always present in-image
+        pass
+    return errs

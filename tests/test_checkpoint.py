@@ -1,0 +1,202 @@
+"""Checkpoint lifecycle hardening (parallel/checkpoint.py).
+
+The reference's snapshot story is a plain ``Module.save`` file write —
+a crash mid-save corrupts the file and the run.  Here every snapshot is
+written to a temp dir, manifested (per-file sha256 + step/epoch meta),
+and published with an atomic rename; restore verifies the manifest and
+falls back to the newest intact older snapshot.  These tests cover each
+fallback branch individually (the integrated chaos paths live in
+test_elastic.py).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from analytics_zoo_tpu.parallel import checkpoint as ckpt
+from analytics_zoo_tpu.resilience.errors import CheckpointCorrupt, InjectedFault
+
+
+def _tree(v: float):
+    return {"w": np.full((4, 3), v, np.float32),
+            "step": np.asarray(7, np.int32)}
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_hook():
+    yield
+    ckpt.set_fault_hook(None)
+
+
+class TestAtomicSave:
+    def test_publish_layout_and_manifest(self, tmp_path):
+        base = str(tmp_path / "c")
+        target = ckpt.save(base, _tree(1.0), step=3,
+                           meta={"epoch": 2, "iteration": 3})
+        assert os.path.basename(target) == "step_3"
+        man = ckpt.verify_snapshot(target)
+        assert man["meta"]["epoch"] == 2
+        assert man["meta"]["state_step"] == 7     # read from the pytree
+        assert man["files"]                       # checksums recorded
+        # no temp/trash residue after a clean publish
+        assert not [d for d in os.listdir(base) if d.startswith(".tmp")]
+
+    def test_mid_save_crash_keeps_previous_snapshot(self, tmp_path):
+        base = str(tmp_path / "c")
+        ckpt.save(base, _tree(1.0))
+
+        def bomb(phase, path):
+            if phase == "pre_publish":
+                raise InjectedFault("crash mid-save")
+
+        ckpt.set_fault_hook(bomb)
+        with pytest.raises(InjectedFault):
+            ckpt.save(base, _tree(2.0))
+        ckpt.set_fault_hook(None)
+        # the old snapshot is untouched AND still verifies
+        out = ckpt.load(base)
+        assert float(out["w"][0, 0]) == 1.0
+        # the crashed save's temp dir does not break the next save
+        ckpt.save(base, _tree(3.0))
+        assert float(ckpt.load(base)["w"][0, 0]) == 3.0
+
+    def test_crash_between_publish_renames_recovers_from_trash(self, tmp_path):
+        """The publish is two renames (old → trash, tmp → target); a
+        crash between them must leave the displaced old snapshot
+        restorable, and the next save must not destroy it pre-publish."""
+        base = str(tmp_path / "c")
+        ckpt.save(base, _tree(1.0))
+        # simulate the crash window: target moved aside, tmp never landed
+        os.rename(os.path.join(base, "latest"),
+                  os.path.join(base, ".trash_latest"))
+        assert ckpt.has_checkpoint(base)
+        assert float(ckpt.load(base)["w"][0, 0]) == 1.0   # trash candidate
+        # a subsequent save publishes cleanly and clears the trash slot
+        ckpt.save(base, _tree(2.0))
+        assert float(ckpt.load(base)["w"][0, 0]) == 2.0
+        assert not os.path.isdir(os.path.join(base, ".trash_latest"))
+
+    def test_keep_last_gc(self, tmp_path):
+        base = str(tmp_path / "c")
+        for s in range(5):
+            ckpt.save(base, _tree(float(s)), step=s, keep_last=2)
+        kept = sorted(d for d in os.listdir(base) if d.startswith("step_"))
+        assert kept == ["step_3", "step_4"]
+        assert float(ckpt.load(base)["w"][0, 0]) == 4.0
+
+
+class TestVerifiedRestore:
+    def test_corrupt_latest_falls_back_to_older_step(self, tmp_path):
+        base = str(tmp_path / "c")
+        ckpt.save(base, _tree(1.0), step=1)
+        t2 = ckpt.save(base, _tree(2.0), step=2)
+        # truncate a checksummed payload file of the newest snapshot
+        man = ckpt.verify_snapshot(t2)
+        rel = max(man["files"], key=lambda r: man["files"][r]["size"])
+        with open(os.path.join(t2, rel), "r+b") as f:
+            f.truncate(3)
+        out = ckpt.load(base)
+        assert float(out["w"][0, 0]) == 1.0   # fell back, did not abort
+
+    def test_missing_file_detected(self, tmp_path):
+        base = str(tmp_path / "c")
+        t = ckpt.save(base, _tree(1.0), step=1)
+        man = ckpt.verify_snapshot(t)
+        os.remove(os.path.join(t, next(iter(man["files"]))))
+        with pytest.raises(CheckpointCorrupt, match="missing file"):
+            ckpt.verify_snapshot(t)
+
+    def test_checksum_mismatch_detected(self, tmp_path):
+        base = str(tmp_path / "c")
+        t = ckpt.save(base, _tree(1.0), step=1)
+        man = ckpt.verify_snapshot(t)
+        rel = max(man["files"], key=lambda r: man["files"][r]["size"])
+        full = os.path.join(t, rel)
+        data = bytearray(open(full, "rb").read())
+        data[-1] ^= 0xFF   # same size, different content
+        open(full, "wb").write(bytes(data))
+        with pytest.raises(CheckpointCorrupt, match="checksum"):
+            ckpt.verify_snapshot(t)
+
+    def test_all_corrupt_raises(self, tmp_path):
+        base = str(tmp_path / "c")
+        for s in (1, 2):
+            t = ckpt.save(base, _tree(float(s)), step=s)
+            man = ckpt.verify_snapshot(t)
+            rel = max(man["files"], key=lambda r: man["files"][r]["size"])
+            with open(os.path.join(t, rel), "r+b") as f:
+                f.truncate(1)
+        with pytest.raises(CheckpointCorrupt, match="no intact snapshot"):
+            ckpt.load(base)
+
+    def test_explicit_step_pin_does_not_fall_back(self, tmp_path):
+        base = str(tmp_path / "c")
+        ckpt.save(base, _tree(1.0), step=1)
+        t2 = ckpt.save(base, _tree(2.0), step=2)
+        man = ckpt.verify_snapshot(t2)
+        rel = next(iter(man["files"]))
+        with open(os.path.join(t2, rel), "r+b") as f:
+            f.truncate(1)
+        with pytest.raises(CheckpointCorrupt):
+            ckpt.load(base, step=2)
+
+
+class TestPathResolution:
+    def test_latest_step_skips_manifestless_dirs(self, tmp_path):
+        base = str(tmp_path / "c")
+        ckpt.save(base, _tree(1.0), step=1)
+        # a partially-written snapshot: directory exists, no manifest
+        os.makedirs(os.path.join(base, "step_9"))
+        assert ckpt.latest_step(base) == 1
+        assert ckpt.latest_step(base, require_manifest=False) == 9
+        # load ignores it too (treated as a corrupt candidate)
+        assert float(ckpt.load(base)["w"][0, 0]) == 1.0
+
+    def test_stale_latest_does_not_outrank_newer_steps(self, tmp_path):
+        """A job that switched from overwrite-'latest' to step-tagged
+        checkpointing must resume from the NEWER step snapshot, not the
+        stale 'latest' slot — candidates order by recorded training
+        position, not slot name."""
+        base = str(tmp_path / "c")
+        ckpt.save(base, _tree(1.0), meta={"iteration": 100})     # 'latest'
+        ckpt.save(base, _tree(2.0), step=200, meta={"iteration": 200})
+        d, man = ckpt.newest_intact(base)
+        assert os.path.basename(d) == "step_200"
+        assert float(ckpt.load(base)["w"][0, 0]) == 2.0
+        # a fresher 'latest' wins again
+        ckpt.save(base, _tree(3.0), meta={"iteration": 300})
+        assert float(ckpt.load(base)["w"][0, 0]) == 3.0
+
+    def test_newest_intact_ordering(self, tmp_path):
+        base = str(tmp_path / "c")
+        ckpt.save(base, _tree(1.0), step=1)
+        ckpt.save(base, _tree(2.0), step=2)
+        d, man = ckpt.newest_intact(base)
+        assert os.path.basename(d) == "step_2"
+        assert man["meta"]["step"] == 2
+
+    def test_legacy_bare_orbax_dir_still_loads(self, tmp_path):
+        # pre-manifest layout: orbax checkpoint AT the directory itself
+        import orbax.checkpoint as ocp
+
+        d = str(tmp_path / "legacy" / "latest")
+        ocp.PyTreeCheckpointer().save(d, _tree(5.0))
+        out = ckpt.load(str(tmp_path / "legacy"))
+        assert float(out["w"][0, 0]) == 5.0
+
+    def test_direct_snapshot_dir_load(self, tmp_path):
+        base = str(tmp_path / "c")
+        t = ckpt.save(base, _tree(4.0), step=4)
+        out = ckpt.load(t)   # the snapshot dir itself as the path
+        assert float(out["w"][0, 0]) == 4.0
+
+    def test_has_checkpoint(self, tmp_path):
+        base = str(tmp_path / "c")
+        assert not ckpt.has_checkpoint(base)
+        ckpt.save(base, _tree(1.0))
+        assert ckpt.has_checkpoint(base)

@@ -124,7 +124,10 @@ fp = float(sum(np.abs(np.asarray(l)).sum()
 print(f"proc {pid} TRAINED steps={steps} fingerprint={fp:.8f}")
 if pid == 0:
     assert os.path.exists(os.path.join(ckpt_dir, "latest")), "no checkpoint"
-    assert os.path.exists(os.path.join(ckpt_dir, "loop_meta.json"))
+    # loop position rides in the snapshot's own manifest now
+    from analytics_zoo_tpu.parallel import checkpoint as _ckpt
+    man = _ckpt.verify_snapshot(os.path.join(ckpt_dir, "latest"))
+    assert man["meta"]["iteration"] == 20, man["meta"]
     print("proc 0 CKPT_OK")
 """
 

@@ -8,6 +8,9 @@ non-finite loss streak must be detected, and the restart budget must be
 enforced.
 """
 
+import os
+import time
+
 import numpy as np
 import pytest
 
@@ -17,14 +20,21 @@ from flax import linen as nn
 from analytics_zoo_tpu.core.criterion import MSECriterion
 from analytics_zoo_tpu.core.module import Model
 from analytics_zoo_tpu.parallel import (
+    RETRYABLE_ERRORS,
     SGD,
     DivergenceDetector,
     FaultInjector,
     Optimizer,
+    Preempted,
+    PrefetchWorkerDied,
+    ShardReadError,
+    StallError,
     Trigger,
     TrainingDiverged,
     run_resilient,
 )
+from analytics_zoo_tpu.parallel import checkpoint as cp
+from analytics_zoo_tpu.resilience.chaos import ChaosMonkey, FaultSpec
 
 
 def _dataset(n_batches=8, batch=8, dim=4, seed=0):
@@ -221,3 +231,255 @@ class TestReviewRegressions:
                .set_end_when(Trigger.max_epoch(1)))
         opt.optimize()
         assert not os.path.exists(os.path.join(ckpt, "latest"))
+
+
+@pytest.fixture(autouse=True)
+def _clear_ckpt_fault_hook():
+    yield
+    cp.set_fault_hook(None)
+
+
+class TestChaosMatrix:
+    """Integrated fault-injection matrix: each chaos kind must be
+    survived by the supervisor with loss-position continuity (resume
+    from a checkpoint, never from scratch)."""
+
+    def _build(self, data, ckpt, **kw):
+        return (Optimizer(_model(), data, MSECriterion(), **kw)
+                .set_optim_method(SGD(0.05))
+                .set_checkpoint(ckpt, Trigger.several_iteration(2),
+                                overwrite=False, keep_last=3)
+                .set_end_when(Trigger.max_epoch(3)))
+
+    def test_mid_save_kill_survived(self, tmp_path):
+        """A crash DURING save (post-write, pre-publish) must not lose
+        the previous snapshot; the restart resumes from it."""
+        ckpt = str(tmp_path / "ckpt")
+        data = _dataset(n_batches=4)
+        monkey = ChaosMonkey([FaultSpec("mid_save_kill", 3)],
+                             checkpoint_path=ckpt)
+        chaos_data = monkey.dataset(data)
+        attempts = []
+
+        def build():
+            attempts.append(1)
+            return self._build(chaos_data, ckpt)
+
+        run_resilient(build, ckpt, max_restarts=3)
+        assert len(attempts) == 2
+        assert [e["kind"] for e in monkey.events] == ["mid_save_kill"]
+        # resumed training still reached the end: 3 epochs x 4 batches
+        state = cp.load(ckpt)
+        assert int(np.asarray(state["step"])) == 12
+
+    def test_corrupt_latest_falls_back_on_resume(self, tmp_path):
+        """Corruption of the newest snapshot + a crash: the restart must
+        restore the newest INTACT older snapshot, not start over."""
+        ckpt = str(tmp_path / "ckpt")
+        data = _dataset(n_batches=4)
+        monkey = ChaosMonkey([FaultSpec("corrupt_latest", 6),
+                              FaultSpec("crash", 7)],
+                             checkpoint_path=ckpt)
+        chaos_data = monkey.dataset(data)
+        resumed_from = []
+
+        def build():
+            found = cp.newest_intact(ckpt)
+            resumed_from.append(
+                int(found[1]["meta"]["iteration"]) if found else None)
+            return self._build(chaos_data, ckpt)
+
+        run_resilient(build, ckpt, max_restarts=3)
+        corrupted = [e for e in monkey.events if e["kind"] == "corrupt_latest"]
+        assert len(corrupted) == 1
+        # second attempt resumed from an intact checkpoint older than the
+        # corrupted one, but NOT from scratch
+        assert len(resumed_from) == 2 and resumed_from[1] is not None
+        corrupt_step = int(corrupted[0]["snapshot"].split("_")[1])
+        assert 0 < resumed_from[1] < corrupt_step
+
+    def test_sigterm_graceful_checkpoint(self, tmp_path):
+        """SIGTERM mid-epoch: the loop checkpoints at the step boundary,
+        raises Preempted, and the restart resumes at that exact point."""
+        ckpt = str(tmp_path / "ckpt")
+        data = _dataset(n_batches=4)
+        monkey = ChaosMonkey([FaultSpec("sigterm", 2)], checkpoint_path=ckpt)
+        chaos_data = monkey.dataset(data)
+        errors = []
+
+        def build():
+            return self._build(chaos_data, ckpt).set_preemption_handler()
+
+        run_resilient(build, ckpt, max_restarts=3,
+                      on_restart=lambda a, e: errors.append(e))
+        assert len(errors) == 1 and isinstance(errors[0], Preempted)
+        # the forced checkpoint landed at the preempt boundary (iteration
+        # 3: batch index 2 trains as the 3rd step) and nothing re-trained:
+        # total steps stay exactly 3 epochs x 4 batches
+        state = cp.load(ckpt)
+        assert int(np.asarray(state["step"])) == 12
+
+    def test_stall_watchdog_raises_instead_of_hanging(self, tmp_path):
+        """A step exceeding the watchdog deadline raises StallError (a
+        retryable) rather than blocking optimize() forever."""
+        data = _dataset(n_batches=4)
+
+        class SleepyData:
+            def __iter__(self):
+                for i, b in enumerate(data):
+                    if i == 2:
+                        time.sleep(2.2)
+                    yield b
+
+        opt = (Optimizer(_model(), SleepyData(), MSECriterion())
+               .set_optim_method(SGD(0.05))
+               .set_stall_watchdog(0.8)
+               .set_end_when(Trigger.max_epoch(2)))
+        t0 = time.time()
+        with pytest.raises(StallError):
+            opt.optimize()
+        assert time.time() - t0 < 30
+        assert isinstance(StallError("x"), RETRYABLE_ERRORS)
+
+    def test_stall_watchdog_with_preemption_handler(self, tmp_path):
+        """The watchdog's simulated SIGINT must not be misread as a
+        preemption request when a PreemptionHandler is installed."""
+        data = _dataset(n_batches=4)
+
+        class SleepyData:
+            def __iter__(self):
+                for i, b in enumerate(data):
+                    if i == 2:
+                        time.sleep(2.2)
+                    yield b
+
+        opt = (Optimizer(_model(), SleepyData(), MSECriterion())
+               .set_optim_method(SGD(0.05))
+               .set_preemption_handler()
+               .set_stall_watchdog(0.8)
+               .set_end_when(Trigger.max_epoch(2)))
+        with pytest.raises(StallError):
+            opt.optimize()
+
+    def test_xla_transient_is_retryable(self, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        data = _dataset(n_batches=4)
+        from analytics_zoo_tpu.resilience.chaos import transient_xla_error
+        attempts = []
+
+        def build():
+            ds = (FaultInjector(data, fail_at=5, exc=transient_xla_error())
+                  if not attempts else data)
+            attempts.append(1)
+            return self._build(ds, ckpt)
+
+        run_resilient(build, ckpt, max_restarts=2)
+        assert len(attempts) == 2
+
+    def test_bare_runtime_error_propagates_immediately(self, tmp_path):
+        """Satellite: a programming bug disguised as RuntimeError must
+        NOT be retried by the default filter."""
+        data = _dataset(n_batches=2)
+        attempts = []
+
+        def build():
+            attempts.append(1)
+            return (Optimizer(_model(),
+                              FaultInjector(data, fail_at=0,
+                                            exc=RuntimeError("real bug")),
+                              MSECriterion())
+                    .set_optim_method(SGD(0.05))
+                    .set_end_when(Trigger.max_epoch(1)))
+
+        with pytest.raises(RuntimeError, match="real bug"):
+            run_resilient(build, str(tmp_path / "c"), max_restarts=5)
+        assert len(attempts) == 1
+
+
+class TestDataFaults:
+    def test_shard_read_transient_retries_then_succeeds(self, tmp_path):
+        from analytics_zoo_tpu.data.records import (
+            ReadStats, RecordWriter, read_records)
+
+        p = str(tmp_path / "s.azr")
+        with RecordWriter(p) as w:
+            for i in range(5):
+                w.write(bytes([i]) * 8)
+        calls = []
+
+        def flaky(path, mode="rb"):
+            calls.append(1)
+            if len(calls) <= 2:
+                raise OSError("transient")
+            return open(path, mode)
+
+        stats = ReadStats()
+        got = list(read_records(p, retries=3, backoff_s=0.01, stats=stats,
+                                opener=flaky))
+        assert len(got) == 5 and stats.retries == 2 and stats.records == 5
+
+    def test_shard_read_retry_exhaustion(self, tmp_path):
+        from analytics_zoo_tpu.data.records import read_records
+
+        p = str(tmp_path / "s.azr")
+        from analytics_zoo_tpu.data.records import RecordWriter
+        with RecordWriter(p) as w:
+            w.write(b"x" * 8)
+
+        def dead(path, mode="rb"):
+            raise OSError("disk gone")
+
+        with pytest.raises(ShardReadError, match="after 2 retries"):
+            list(read_records(p, retries=2, backoff_s=0.01, opener=dead))
+
+    def test_ssd_records_skip_and_count(self, tmp_path):
+        from analytics_zoo_tpu.data.records import (
+            ReadStats, RecordWriter, SSDByteRecord, read_ssd_records)
+
+        p = str(tmp_path / "s.azr")
+        with RecordWriter(p) as w:
+            w.write(SSDByteRecord(data=b"a" * 10, path="a.jpg").encode())
+            w.write(b"\x03bad")                  # undecodable
+            w.write(SSDByteRecord(data=b"b" * 10, path="b.jpg").encode())
+        stats = ReadStats()
+        got = list(read_ssd_records([p], skip_errors=True, stats=stats))
+        assert [r.path for r in got] == ["a.jpg", "b.jpg"]
+        assert stats.skipped_records == 1
+        # without skip_errors the decode error propagates
+        with pytest.raises(Exception):
+            list(read_ssd_records([p]))
+
+    def test_prefetch_dead_worker_raises_not_hangs(self):
+        """Satellite: q.get() must not block forever when the worker died
+        without delivering the stop sentinel."""
+        import queue
+        import threading
+
+        from analytics_zoo_tpu.data.prefetch import _drain
+
+        q = queue.Queue()
+        q.put("item0")
+        dead = threading.Thread(target=lambda: None)
+        dead.start()
+        dead.join()   # worker is gone, no sentinel enqueued
+        gen = _drain(q, object(), [], dead, poll_s=0.05)
+        assert next(gen) == "item0"   # queued items still drain first
+        t0 = time.time()
+        with pytest.raises(PrefetchWorkerDied, match="without delivering"):
+            next(gen)
+        assert time.time() - t0 < 5
+        assert isinstance(PrefetchWorkerDied("x"), RETRYABLE_ERRORS)
+
+    def test_prefetch_dead_worker_with_recorded_error(self):
+        import queue
+        import threading
+
+        from analytics_zoo_tpu.data.prefetch import _drain
+
+        q = queue.Queue()
+        dead = threading.Thread(target=lambda: None)
+        dead.start()
+        dead.join()
+        boom = ValueError("worker exploded")
+        with pytest.raises(ValueError, match="worker exploded"):
+            list(_drain(q, object(), [boom], dead, poll_s=0.05))

@@ -151,3 +151,35 @@ class TestMemorySummary:
         assert isinstance(out, dict) and len(out) >= 1
         for stats in out.values():
             assert isinstance(stats, dict)
+
+
+class TestChaosDrillHelpers:
+    """Fast pieces of tools/chaos_drill.py (the full drill is the
+    committed RESILIENCE_r01.json execution)."""
+
+    def test_schedule_is_seeded_deterministic(self):
+        import random
+
+        from tools.chaos_drill import build_schedule
+
+        a = build_schedule(random.Random(7))
+        b = build_schedule(random.Random(7))
+        assert [(f.kind, f.at_batch) for f in a] == \
+               [(f.kind, f.at_batch) for f in b]
+        kinds = {f.kind for f in a}
+        assert {"sigterm", "mid_save_kill", "stall", "corrupt_latest",
+                "xla_transient", "crash"} <= kinds
+        # corruption is always followed by its fallback-forcing crash
+        assert a[-2].kind == "corrupt_latest"
+        assert a[-1] == type(a[-1])("crash", a[-2].at_batch + 1)
+
+    def test_shard_read_drill_survives(self, tmp_path):
+        import random
+
+        from tools.chaos_drill import shard_read_drill
+
+        out = shard_read_drill(str(tmp_path), random.Random(0))
+        assert out["survived"] is True
+        assert out["retries"] == out["injected_transient_errors"] == 2
+        assert out["skipped_records"] == 1
+        assert out["records_read"] == out["records_written"] - 1
