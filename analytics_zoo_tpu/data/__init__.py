@@ -31,6 +31,10 @@ from analytics_zoo_tpu.data.records import (
 from analytics_zoo_tpu.data.prefetch import (PrefetchDataSet,
                                              device_prefetch,
                                              overlap_window)
+from analytics_zoo_tpu.data.parallel import (ParallelLoader,
+                                             make_input_pipeline,
+                                             seed_rngs,
+                                             stable_seed)
 from analytics_zoo_tpu.data.synthetic import (
     SHAPE_CLASSES,
     generate_shapes_records,

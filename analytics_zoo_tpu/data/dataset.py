@@ -20,6 +20,11 @@ from analytics_zoo_tpu.data.transformer import Transformer
 
 
 class DataSet:
+    #: False when the SOURCE's record order is not reproducible across
+    #: iterations (e.g. the threaded native reader) — the multiprocess
+    #: loader requires replayable order and refuses such sources
+    _order_deterministic: bool = True
+
     def __init__(self, source_fn: Callable[[], Iterator[Any]],
                  size: Optional[int] = None):
         self._source_fn = source_fn
@@ -78,7 +83,10 @@ class DataSet:
                 for payload in records_lib.read_records(p):
                     yield decode_fn(payload) if decode_fn else payload
 
-        return DataSet(source)
+        ds = DataSet(source)
+        if native_threads > 0:
+            ds._order_deterministic = False
+        return ds
 
     @staticmethod
     def from_arrays(shuffle: bool = False, seed: int = 0, **arrays) -> "DataSet":
@@ -100,13 +108,31 @@ class DataSet:
     def transform(self, t: Transformer) -> "DataSet":
         out = DataSet(self._source_fn, self._size)
         out._stages = self._stages + [t]
+        out._order_deterministic = self._order_deterministic
         return out
 
     __rshift__ = transform
 
     def batch(self, batch_size: int, collate_fn: Optional[Callable] = None,
-              drop_remainder: bool = True) -> "DataSet":
-        return self.transform(Batcher(batch_size, collate_fn, drop_remainder))
+              drop_remainder: bool = True, num_workers: int = 0,
+              base_seed: int = 0):
+        """Batch the stream.  ``num_workers > 0`` returns the batched
+        dataset wrapped in a :class:`~analytics_zoo_tpu.data.parallel.
+        ParallelLoader` — per-sample transforms fan out to that many
+        worker processes (shared-memory rings, order-preserving,
+        deterministically seeded); this is a terminal combinator, so
+        attach further transforms before ``batch``."""
+        out = self.transform(Batcher(batch_size, collate_fn, drop_remainder))
+        if num_workers > 0:
+            return out.parallel(num_workers, base_seed=base_seed)
+        return out
+
+    def parallel(self, num_workers: int, base_seed: int = 0, **kw):
+        """Wrap in a multiprocess :class:`~analytics_zoo_tpu.data.
+        parallel.ParallelLoader` (``num_workers=0`` = the deterministic
+        in-process serial reference path)."""
+        from analytics_zoo_tpu.data.parallel import ParallelLoader
+        return ParallelLoader(self, num_workers, base_seed=base_seed, **kw)
 
     def shuffle(self, buffer_size: int = 1024, seed: Optional[int] = None
                 ) -> "DataSet":

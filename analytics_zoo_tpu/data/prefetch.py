@@ -51,12 +51,22 @@ def _drain(q: "queue.Queue", stop: object, err: list, worker,
         yield item
 
 
-def device_prefetch(batches: Iterable[Any], mesh, size: int = 2) -> Iterator[Any]:
+def device_prefetch(batches: Iterable[Any], mesh, size: int = 2,
+                    close_source: bool = False) -> Iterator[Any]:
     """Yield device-resident, data-sharded batches, staying ``size`` ahead.
 
     Early consumer exit (e.g. the train loop breaking on ``end_when``) is
     handled: closing the generator signals the worker to stop, so no thread
     is left blocked holding device buffers.
+
+    ``close_source=True`` additionally closes ``batches`` itself when the
+    stream ends or is cancelled — FROM THE WORKER THREAD, which is the
+    only thread ever executing the source generator (a consumer-side
+    ``close()`` on a generator suspended inside another thread's
+    ``next()`` raises).  Use it when the source owns real resources —
+    e.g. a multiprocess ``ParallelLoader`` epoch whose worker processes
+    must not outlive the stream.  Leave it False when the caller reuses
+    the source across several prefetch streams (``bench_overlap``).
     """
     if size < 1:
         # a non-positive maxsize would make the Queue UNBOUNDED and the
@@ -82,6 +92,11 @@ def device_prefetch(batches: Iterable[Any], mesh, size: int = 2) -> Iterator[Any
         except BaseException as e:  # propagate to consumer
             err.append(e)
         finally:
+            if close_source and hasattr(batches, "close"):
+                try:
+                    batches.close()
+                except Exception:  # noqa: BLE001 - cleanup best-effort
+                    pass
             # Block until the stop sentinel fits — NEVER pop queued real
             # batches to make room (a slow consumer keeps the queue full
             # at end-of-stream, and popping would silently drop batches).
@@ -99,18 +114,58 @@ def device_prefetch(batches: Iterable[Any], mesh, size: int = 2) -> Iterator[Any
         yield from _drain(q, stop, err, t)
     finally:
         cancelled.set()
+        if close_source:
+            # Wait for the worker to actually finish: its cleanup
+            # (closing a multiprocess loader epoch = reaping worker
+            # processes + advancing the source state) must COMPLETE
+            # before control returns to the consumer — an immediately
+            # restarted epoch would otherwise fork new workers from the
+            # not-yet-advanced source state (replaying the old shuffle
+            # order) while two pools briefly coexist.  Bounded: the
+            # worker observes ``cancelled`` within one batch
+            # production.  Without close_source there is nothing to
+            # reap, and blocking here would stall the very paths (e.g.
+            # StallWatchdog recovery around a hung source) that close
+            # early.  The timeout bounds stall-recovery latency when
+            # the source itself is the thing that hung — but a timeout
+            # means the completion invariant did NOT hold, so say so.
+            t.join(timeout=5.0)
+            if t.is_alive():
+                import logging
+
+                logging.getLogger("analytics_zoo_tpu").warning(
+                    "prefetch worker still closing its source after the "
+                    "5s grace — an immediately restarted epoch may fork "
+                    "workers from a stale source state")
 
 
 class PrefetchDataSet:
-    """Wrap a DataSet so every epoch iterates device-resident batches."""
+    """Wrap a DataSet so every epoch iterates device-resident batches.
 
-    def __init__(self, dataset, mesh, size: int = 2):
+    ``size`` is the staging depth: 2 = double buffering (batch ``t+1``
+    transfers while the step runs on ``t``), 3 = triple.  ``num_workers
+    > 0`` additionally fans the host decode/augment work out to that
+    many processes (``data.parallel.ParallelLoader``) before the
+    overlapped H2D stage — the full host-input pipeline in one wrapper.
+    Early consumer exit closes the host iterator too, so worker
+    processes never outlive the epoch."""
+
+    def __init__(self, dataset, mesh, size: int = 2, num_workers: int = 0,
+                 base_seed: int = 0, **loader_kw):
+        if num_workers > 0:
+            from analytics_zoo_tpu.data.parallel import ParallelLoader
+            dataset = ParallelLoader(dataset, num_workers,
+                                     base_seed=base_seed, **loader_kw)
         self.dataset = dataset
         self.mesh = mesh
         self.size = size
 
     def __iter__(self):
-        return device_prefetch(iter(self.dataset), self.mesh, self.size)
+        # close_source: the epoch iterator (possibly a multiprocess
+        # loader owning worker processes) is closed by the prefetch
+        # worker thread itself — the only thread executing it
+        return device_prefetch(iter(self.dataset), self.mesh, self.size,
+                               close_source=True)
 
     def __len__(self):
         return len(self.dataset)

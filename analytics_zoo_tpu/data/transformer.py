@@ -50,7 +50,14 @@ class Transformer:
         return c
 
 
-def _reseed_rngs(obj: Any, _seen: Optional[set] = None) -> None:
+def walk_rngs(obj: Any, visit: Callable[[Any], None],
+              _seen: Optional[set] = None) -> None:
+    """Recursively find every RNG reachable from ``obj`` (attribute /
+    dict / sequence walk, stable traversal order) and call ``visit`` on
+    it.  Recognizes ``random.Random``, ``np.random.RandomState`` and
+    ``np.random.Generator``.  The ONE discovery walk shared by
+    ``clone()``'s entropy reseed below and the deterministic per-sample
+    seeding in ``data.parallel`` — two traversals would drift."""
     import numpy as _np
 
     if _seen is None:
@@ -58,20 +65,34 @@ def _reseed_rngs(obj: Any, _seen: Optional[set] = None) -> None:
     if id(obj) in _seen:
         return
     _seen.add(id(obj))
-    if isinstance(obj, random.Random):
-        obj.seed(int.from_bytes(os.urandom(8), "little"))
-        return
-    if isinstance(obj, _np.random.RandomState):
-        obj.seed(int.from_bytes(os.urandom(4), "little"))
+    if isinstance(obj, (random.Random, _np.random.RandomState,
+                        _np.random.Generator)):
+        visit(obj)
         return
     if isinstance(obj, dict):
         for v in obj.values():
-            _reseed_rngs(v, _seen)
+            walk_rngs(v, visit, _seen)
     elif isinstance(obj, (list, tuple)):
         for v in obj:
-            _reseed_rngs(v, _seen)
+            walk_rngs(v, visit, _seen)
     elif hasattr(obj, "__dict__"):
-        _reseed_rngs(vars(obj), _seen)
+        walk_rngs(vars(obj), visit, _seen)
+
+
+def _reseed_rngs(obj: Any) -> None:
+    import numpy as _np
+
+    def visit(rng):
+        if isinstance(rng, random.Random):
+            rng.seed(int.from_bytes(os.urandom(8), "little"))
+        elif isinstance(rng, _np.random.RandomState):
+            rng.seed(int.from_bytes(os.urandom(4), "little"))
+        else:   # np.random.Generator — same bit-generator type (a
+            # Philox state assigned to a PCG64 raises)
+            rng.bit_generator.state = type(rng.bit_generator)(
+                int.from_bytes(os.urandom(8), "little")).state
+
+    walk_rngs(obj, visit)
 
 
 class ChainedTransformer(Transformer):

@@ -589,8 +589,12 @@ class Optimizer:
                         break
                     self._skip_batches -= 1
                     self._iter_in_epoch += 1
+                # close_source: the prefetch worker thread closes
+                # host_iter itself on cancel/end — a consumer-side close
+                # could land while the thread is inside next(host_iter)
                 epoch_batches = (device_prefetch(host_iter, self.mesh,
-                                                 self.prefetch)
+                                                 self.prefetch,
+                                                 close_source=True)
                                  if self.prefetch else host_iter)
                 try:
                     for batch in epoch_batches:
@@ -627,7 +631,10 @@ class Optimizer:
                             break
                 finally:
                     # early exit (end_when break / detector raise): release
-                    # the prefetch worker and its HBM-pinned queued batches
+                    # the prefetch worker and its HBM-pinned queued batches;
+                    # close_source above hands host_iter (possibly a
+                    # multiprocess loader epoch owning worker processes)
+                    # to the prefetch thread for closing
                     if hasattr(epoch_batches, "close"):
                         epoch_batches.close()
                 if stop:

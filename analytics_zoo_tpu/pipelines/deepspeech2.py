@@ -512,3 +512,53 @@ class StreamingDS2:
         if not self._log_probs:
             return np.zeros((0, 0), np.float32)
         return np.concatenate(self._log_probs, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Training input pipeline
+# ---------------------------------------------------------------------------
+
+
+def load_asr_train_set(samples: np.ndarray, labels: np.ndarray,
+                       label_lengths: Optional[np.ndarray] = None,
+                       batch_size: int = 8,
+                       utt_length: Optional[int] = None,
+                       n_mels: int = 13, shuffle: bool = True,
+                       seed: int = 0, worker_processes: int = 0):
+    """DataSet of featurized CTC train batches from raw waveforms.
+
+    The host featurize (frame → rFFT → mel, ``transform.audio.
+    featurize``) is the per-sample hot loop, so ``worker_processes > 0``
+    fans it out through the multiprocess loader
+    (``data.parallel.ParallelLoader`` — shared-memory rings,
+    order-preserving, deterministically seeded).  Prefer
+    ``make_featurizer_device`` fused into the train step when the chip
+    has headroom; this host path is for hosts feeding featurize-bound
+    accelerators, and is the DS2 wiring of docs/PERFORMANCE.md "Host
+    input pipeline".
+
+    ``samples``: (N, S) float32 waveforms; ``labels``: (N, L) int32
+    (0-padded); ``label_lengths``: (N,) true lengths (defaults to
+    counting nonzero labels).  Batches: ``{"input", "labels",
+    "label_mask"}`` ready for ``CTCCriterion``.
+    """
+    from analytics_zoo_tpu.data import DataSet, FnTransformer
+
+    samples = np.asarray(samples, np.float32)
+    labels = np.asarray(labels, np.int32)
+    if label_lengths is None:
+        label_lengths = (labels != 0).sum(axis=1).astype(np.int32)
+    L = labels.shape[1]
+
+    def feat(s):
+        x = featurize(s["samples"], utt_length=utt_length, n_mels=n_mels)
+        mask = (np.arange(L) < s["n_label"]).astype(np.float32)
+        return {"input": x.astype(np.float32), "labels": s["labels"],
+                "label_mask": mask}
+
+    return (DataSet.from_arrays(samples=samples, labels=labels,
+                                n_label=label_lengths,
+                                shuffle=shuffle, seed=seed)
+            .transform(FnTransformer(feat))
+            .batch(batch_size, num_workers=worker_processes,
+                   base_seed=seed))

@@ -154,6 +154,9 @@ def frcnn_train_batches(dataset, resolution: int):
     assignment."""
 
     class _DS:
+        def __len__(self):
+            return len(dataset)
+
         def __iter__(self):
             for b in dataset:
                 B = b["input"].shape[0]
@@ -192,8 +195,10 @@ def train_frcnn(model, dataset, resolution: int, epochs: int = 10,
 
     ``model``: a ``core.Model`` wrapping ``FasterRcnnVgg``; ``dataset``
     yields SSD-style labeled batches with NORMALIZED gt (e.g.
-    ``pipelines.ssd.load_train_set``) — adapted via
-    :func:`frcnn_train_batches`.
+    ``pipelines.ssd.load_train_set`` — pass ``PreProcessParam(
+    worker_processes=N)`` there to fan the decode/augment host work out
+    to the multiprocess loader; the adapter preserves its ordering and
+    early-close semantics) — adapted via :func:`frcnn_train_batches`.
     """
     from analytics_zoo_tpu.ops.frcnn_train import (FrcnnLossParam,
                                                    frcnn_training_loss)

@@ -87,6 +87,14 @@ class PreProcessParam:
     # host augmentation worker threads (SURVEY.md §7.3 hard part 4);
     # 1 = serial (deterministic order), >1 = ParallelTransformer pool
     num_workers: int = 1
+    # host augmentation worker PROCESSES (data.parallel.ParallelLoader):
+    # 0 = in-process; >0 fans decode+augment out to that many forked
+    # workers with shared-memory rings — order-preserving and, unlike
+    # the thread pool, deterministically seeded (byte-identical stream
+    # for any worker count, seeded from loader_seed).  When set, the
+    # thread-pool num_workers is ignored (the process pool replaces it).
+    worker_processes: int = 0
+    loader_seed: int = 0
     # record-level windowed shuffle (data.ShuffleBuffer) applied to the
     # decoded record stream; 0 disables (file-order shuffle still on).
     # Replaces the global shuffle Spark RDD repartitioning provided.
@@ -224,6 +232,16 @@ def _maybe_parallel(t: Transformer, workers: int) -> Transformer:
     return ParallelTransformer(t, workers) if workers > 1 else t
 
 
+def _maybe_loader(ds: DataSet, param: PreProcessParam):
+    """Wrap the assembled dataset in the multiprocess loader when the
+    param asks for worker processes (docs/PERFORMANCE.md "Host input
+    pipeline"); otherwise return the DataSet unchanged."""
+    if param.worker_processes > 0:
+        return ds.parallel(param.worker_processes,
+                           base_seed=param.loader_seed)
+    return ds
+
+
 def load_train_set_device(pattern: str, param: PreProcessParam,
                           aug: Optional["DeviceAugParam"] = None):
     """Device-augmentation train path (``transform/vision/device.py``):
@@ -256,7 +274,7 @@ def load_train_set_device(pattern: str, param: PreProcessParam,
     ds = (ds.transform(_maybe_parallel(chain, param.num_workers))
           .transform(DeviceAugBatch(param.batch_size, param.max_gt,
                                     pack=aug.pack)))
-    return ds, make_device_augment(aug)
+    return _maybe_loader(ds, param), make_device_augment(aug)
 
 
 def _warn_host_chain_ignores_wire(param: PreProcessParam, fn: str) -> None:
@@ -289,8 +307,14 @@ def load_train_set(pattern: str, param: PreProcessParam,
         ds = ds.shuffle(param.shuffle_buffer, seed=param.shuffle_seed)
     chain = (train_transformer(param) if augment
              else val_transformer(param, flip=True))
-    return (ds.transform(_maybe_parallel(chain, param.num_workers))
-            .transform(RoiImageToBatch(param.batch_size, param.max_gt)))
+    if param.worker_processes > 0:
+        # strip decode bytes + working mat (im_info materialized first)
+        # so the shared-memory ring ships only what the batcher reads
+        from analytics_zoo_tpu.transform.vision import SealForWire
+        chain = chain >> SealForWire()
+    return _maybe_loader(
+        ds.transform(_maybe_parallel(chain, param.num_workers))
+        .transform(RoiImageToBatch(param.batch_size, param.max_gt)), param)
 
 
 def load_val_set(pattern: str, param: PreProcessParam) -> DataSet:
@@ -298,11 +322,19 @@ def load_val_set(pattern: str, param: PreProcessParam) -> DataSet:
     # PreProcessParam between load_train_set_device and this val loader
     # (examples/train_ssd.py), and validation has no device-aug variant
     # to redirect to
-    return (DataSet.from_record_files(pattern, SSDByteRecord.decode)
-            .transform(_maybe_parallel(val_transformer(param),
-                                       param.num_workers))
-            .transform(RoiImageToBatch(param.batch_size, param.max_gt,
-                                       drop_remainder=False)))
+    chain = val_transformer(param)
+    if param.worker_processes > 0:
+        # same wire shrink as load_train_set: RoiImageToBatch reads only
+        # floats/im_info/labels, so the decode bytes + working mat are
+        # dead weight through the shared-memory ring (and raw JPEG bytes
+        # pickle IN-BAND — they would blow the slot budget)
+        from analytics_zoo_tpu.transform.vision import SealForWire
+        chain = chain >> SealForWire()
+    return _maybe_loader(
+        DataSet.from_record_files(pattern, SSDByteRecord.decode)
+        .transform(_maybe_parallel(chain, param.num_workers))
+        .transform(RoiImageToBatch(param.batch_size, param.max_gt,
+                                   drop_remainder=False)), param)
 
 
 class SSDPredictor:

@@ -41,9 +41,16 @@ class StallError(RuntimeError):
 
 
 class PrefetchWorkerDied(RuntimeError):
-    """The prefetch worker thread died without enqueueing its stop
-    sentinel — the consumer would previously block on ``q.get()``
-    forever.  Retryable: a fresh attempt restarts the worker."""
+    """An input-pipeline worker died and could not be replaced.
+
+    Raised by (a) the prefetch thread (``data.prefetch``) when the
+    worker thread dies without enqueueing its stop sentinel — the
+    consumer would previously block on ``q.get()`` forever — and (b)
+    the multiprocess loader (``data.parallel.ParallelLoader``) when a
+    worker PROCESS dies and the bounded respawn budget
+    (``max_respawns`` per epoch; deterministic seeding lets a respawn
+    recompute exactly the groups still owed) is exhausted.  Retryable:
+    a fresh attempt rebuilds the whole input pipeline."""
 
 
 class CheckpointCorrupt(RuntimeError):

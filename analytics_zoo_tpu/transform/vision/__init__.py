@@ -8,6 +8,7 @@ ROI label co-transforms, and the SSD batch samplers.
 from analytics_zoo_tpu.transform.vision.image import (
     FeatureTransformer,
     ImageFeature,
+    SealForWire,
 )
 from analytics_zoo_tpu.transform.vision.augmentation import (
     AspectScale,

@@ -110,6 +110,27 @@ class ImageFeature:
         return np.transpose(out, (2, 0, 1)) if to_chw else out
 
 
+
+class SealForWire(Transformer):
+    """Shrink a transformed ImageFeature for cross-process transport.
+
+    Once the float tensor exists, the decode bytes and the working mat
+    are dead weight — but ``get_im_info`` derives its values from the
+    mat, so the im_info is materialized FIRST (identical values), then
+    the bulky intermediates drop.  Appended to the train chain by the
+    multiprocess loader path (``pipelines.ssd``): halves-or-better the
+    bytes each sample pays through the shared-memory ring
+    (``data.parallel``) without changing anything a batcher reads."""
+
+    def transform(self, feature: "ImageFeature") -> "ImageFeature":
+        if (isinstance(feature, ImageFeature)
+                and feature.get("floats") is not None):
+            if "im_info" not in feature.state:
+                feature.state["im_info"] = feature.get_im_info()
+            feature.state.pop("bytes", None)
+            feature.state.pop("mat", None)
+        return feature
+
 class FeatureTransformer(Transformer):
     """Vision transformer over ImageFeatures (reference
     ``FeatureTransformer``, ``image/Types.scala:167``).

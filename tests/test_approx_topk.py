@@ -1,0 +1,89 @@
+"""Empirical guardrail for ``DetectionOutputParam.approx_topk``.
+
+The docstring in ``ops/detection_output.py`` promises the approx path's
+misses are NOT confined to low ranks — any candidate colliding with a
+larger one in its ``approx_max_k`` partition bin can drop — and that the
+guardrail is therefore *empirical*.  This test IS that guardrail: exact
+vs approx top-k on seeded detections, with the observed top-detection
+drop rate committed and pinned.
+
+Committed observations (seeded inputs below, recall_target=0.95):
+
+- cpu backend (approx_max_k lowers to the exact sort): top-1 drop rate
+  0.0, top-10 drop rate 0.0 (0/40).
+- The pinned bounds leave the algorithmic headroom the docstring
+  documents: top-1 must NEVER drop (the global max is the max of its
+  own bin, and ``aggregate_to_topk`` finishes with an exact top_k, so a
+  top-1 drop means the kernel contract broke), and top-10 drops must
+  stay within the 1-recall_target budget.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from analytics_zoo_tpu.models import build_priors, ssd300_config  # noqa: E402
+from analytics_zoo_tpu.ops import DetectionOutputParam  # noqa: E402
+from analytics_zoo_tpu.ops.detection_output import (  # noqa: E402
+    _detection_output_pallas)
+
+# pinned bounds — regressions past these fail the build
+MAX_TOP1_DROP_RATE = 0.0
+MAX_TOP10_DROP_RATE = 0.05          # the 1-recall_target budget
+
+
+def _seeded_detections(B=4, C=21):
+    priors, variances = build_priors(ssd300_config())
+    P = priors.shape[0]
+    rng = np.random.RandomState(0)
+    loc = jnp.asarray(rng.randn(B, P, 4).astype(np.float32) * 0.1)
+    logits = rng.randn(B, P, C).astype(np.float32)
+    logits[:, :, 0] += 4.0          # background-dominated, as served
+    conf = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+    return loc, conf, jnp.asarray(priors), jnp.asarray(variances)
+
+
+def _drop_rate(exact, approx, k):
+    """Fraction of the exact path's top-k detections missing from the
+    approx path's keep set (match = same score and box)."""
+    drops = total = 0
+    for b in range(exact.shape[0]):
+        ap = approx[b]
+        for row in exact[b][:k]:
+            if row[0] < 0:
+                continue
+            total += 1
+            hit = np.any((np.abs(ap[:, 1] - row[1]) < 1e-6)
+                         & (np.abs(ap[:, 2:] - row[2:]).max(axis=1) < 1e-5))
+            drops += 0 if hit else 1
+    return drops / max(total, 1)
+
+
+def test_approx_topk_drop_rate_within_pinned_bounds():
+    loc, conf, priors, variances = _seeded_detections()
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    exact = np.asarray(_detection_output_pallas(
+        loc, conf, priors, variances,
+        param=DetectionOutputParam(approx_topk=False), interpret=not on_tpu))
+    approx = np.asarray(_detection_output_pallas(
+        loc, conf, priors, variances,
+        param=DetectionOutputParam(approx_topk=True, approx_recall=0.95),
+        interpret=not on_tpu))
+
+    top1 = _drop_rate(exact, approx, 1)
+    top10 = _drop_rate(exact, approx, 10)
+    assert top1 <= MAX_TOP1_DROP_RATE, (
+        f"approx_topk dropped the TOP detection at rate {top1}: the "
+        "global max must survive partition-reduce + aggregate_to_topk")
+    assert top10 <= MAX_TOP10_DROP_RATE, (
+        f"approx_topk top-10 drop rate {top10} exceeds the "
+        f"{MAX_TOP10_DROP_RATE} (1-recall_target) budget — regression "
+        "past the pinned empirical guardrail")
+
+
+def test_approx_topk_default_stays_exact():
+    """The DEFAULT config must keep the exact top_k (the docstring's
+    'the default stays exact' promise)."""
+    assert DetectionOutputParam().approx_topk is False

@@ -83,6 +83,13 @@ def main() -> int:
                         "scoped programs + a roofline estimate naming the "
                         "residual non-MXU time; writes --out "
                         "(default MFU_CEILING.json)")
+    p.add_argument("--mining-ab", action="store_true",
+                   help="bank the mining='topk' vs 'sort' claim (ISSUE r5 "
+                        "satellite): time the standalone jitted "
+                        "MultiBoxLoss fwd+bwd under both hard-negative "
+                        "engines and MERGE the reading into --out "
+                        "(default MFU_PROFILE.json) under 'mining_topk_ab' "
+                        "with the device kind recorded per-section")
     p.add_argument("--out", default=None)
     args = p.parse_args()
     if args.out is None:
@@ -111,6 +118,64 @@ def main() -> int:
     priors, variances = build_priors(model.module.config)
     criterion = MultiBoxLoss(priors, variances, MultiBoxLossParam())
     optim = SGD(1e-3, momentum=0.9)
+
+    if args.mining_ab:
+        # standalone loss fwd+bwd A/B — the exact program the
+        # MFU_CEILING.md mining table describes, now committed as a
+        # merge-in section of the MFU profile artifact so the doc claim
+        # is BANKED, not prose.  The gradient runs w.r.t. (loc, conf),
+        # matching the in-step backward through the detector heads.
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.ops import MultiBoxLossParam as MBParam
+
+        B = args.batches[0]
+        n_p = np.asarray(priors).shape[0]
+        rng = np.random.RandomState(0)
+        loc = jnp.asarray(rng.randn(B, n_p, 4).astype(np.float32) * 0.1)
+        conf = jnp.asarray(rng.randn(B, n_p, 21).astype(np.float32))
+        target = {
+            "bboxes": jnp.asarray(np.tile(np.asarray(
+                [0.1, 0.1, 0.6, 0.6], np.float32), (B, 4, 1))),
+            "labels": jnp.ones((B, 4), jnp.int32),
+            "mask": jnp.ones((B, 4), jnp.float32),
+        }
+        section = {"device_kind": kind, "batch": B, "priors": int(n_p),
+                   "iters": args.iters}
+        times = {}
+        for mining in ("sort", "topk"):
+            crit = MultiBoxLoss(priors, variances,
+                                MBParam(mining=mining))
+
+            def loss(lc, cf, crit=crit):
+                return crit((lc, cf), target)
+
+            jf = jax.jit(loss)
+            jg = jax.jit(jax.grad(loss, argnums=(0, 1)))
+            times[mining] = {
+                "loss_fwd_ms": round(timed(jf, loc, conf,
+                                           iters=args.iters) * 1e3, 2),
+                "loss_fwd_bwd_ms": round(timed(jg, loc, conf,
+                                               iters=args.iters) * 1e3, 2),
+            }
+        section.update(times)
+        section["fwd_bwd_speedup_topk_vs_sort"] = round(
+            times["sort"]["loss_fwd_bwd_ms"]
+            / max(times["topk"]["loss_fwd_bwd_ms"], 1e-9), 3)
+        section["note"] = (
+            "standalone jitted MultiBoxLoss fwd+bwd (grad w.r.t. "
+            "loc/conf); per-section device_kind — compare only within "
+            "one device.  In-step MFU deltas require the full-step "
+            "rerun (MFU_CEILING_r4mining.json methodology).")
+        merged = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                merged = json.load(f)
+        merged["mining_topk_ab"] = section
+        print(json.dumps(section, indent=2))
+        with open(args.out, "w") as f:
+            json.dump(merged, f, indent=2)
+        return 0
 
     report = {"device_kind": kind, "peak_bf16_tflops": peak,
               "resolution": args.res, "stages": {}, "batch_sweep": []}
