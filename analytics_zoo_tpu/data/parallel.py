@@ -563,6 +563,11 @@ class ParallelLoader:
         # observability (tests + chaos drills read these)
         self.respawns = 0
         self.spills = 0
+        #: epoch index of the most recently STARTED epoch (None before
+        #: the first) — the anomaly sentinel records it as the replay
+        #: coordinate of a bad batch (with base_seed + batch index, the
+        #: determinism contract pins the batch; see replay_batches)
+        self.last_epoch: Optional[int] = None
         self._procs: List[mp.Process] = []
         if num_workers > 0 and not hasattr(os, "fork"):  # pragma: no cover
             warnings.warn("platform lacks fork(); ParallelLoader falls "
@@ -589,6 +594,7 @@ class ParallelLoader:
                 "epoch (ParallelLoader supports one live iterator)")
         epoch = self._epoch
         self._epoch += 1
+        self.last_epoch = epoch
         if self.num_workers == 0:
             return self._serial_epoch(epoch)
         return self._apply_trailing(self._merged_samples(epoch))
@@ -761,6 +767,53 @@ class ParallelLoader:
                 raise PrefetchWorkerDied(
                     f"worker {w} sent group {idx}, expected {g}")
             return "grp", obj
+
+
+# ---------------------------------------------------------------------------
+# Deterministic replay (anomaly forensics re-seek hook)
+# ---------------------------------------------------------------------------
+
+
+def replay_batches(dataset, epoch: int, batch_indices: Sequence[int],
+                   base_seed: int = 0, batch_transform=None):
+    """Re-materialize exact batches of ``epoch`` under the determinism
+    contract — the forensics hook behind ``tools/replay_batch.py``.
+
+    ``dataset`` must be FRESHLY CONSTRUCTED (its source at epoch-0
+    state): a :class:`ParallelLoader` (its own ``base_seed``/grouping
+    win) or a bare ``DataSet`` (wrapped on the serial path with
+    ``base_seed``).  The source is fast-forwarded ``epoch`` epochs, the
+    per-epoch/per-sample RNGs are re-pinned exactly as the live run
+    pinned them — for ANY worker count, including the failed run's —
+    and the requested 0-based batch indices of that epoch are returned
+    as ``{index: batch}``.  ``batch_transform(batch, index)``
+    post-processes each batch (drills re-apply a recorded injected
+    corruption here so the replayed bytes match the recorded hash).
+    """
+    if isinstance(dataset, ParallelLoader):
+        loader = ParallelLoader(dataset.dataset, 0,
+                                base_seed=dataset.base_seed,
+                                group_size=dataset.group_size)
+    else:
+        loader = ParallelLoader(dataset, 0, base_seed=base_seed)
+    want = sorted({int(i) for i in batch_indices})
+    if not want:
+        return {}
+    _advance_source_epochs(loader.dataset._source_fn, epoch)
+    out = {}
+    for i, batch in enumerate(loader._serial_epoch(epoch)):
+        if i in want:
+            out[i] = (batch_transform(batch, i) if batch_transform
+                      else batch)
+        if i >= want[-1]:
+            break
+    missing = [i for i in want if i not in out]
+    if missing:
+        raise ValueError(
+            f"epoch {epoch} ended before batch index(es) {missing} — "
+            "wrong epoch coordinate, or the dataset was not freshly "
+            "constructed (its source state already advanced)")
+    return out
 
 
 # ---------------------------------------------------------------------------

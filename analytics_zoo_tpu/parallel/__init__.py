@@ -76,6 +76,8 @@ from analytics_zoo_tpu.parallel.elastic import (
     run_resilient,
 )
 from analytics_zoo_tpu.resilience import (
+    FATAL_ERRORS,
+    AnomalyPolicy,
     CheckpointCorrupt,
     InjectedFault,
     Preempted,

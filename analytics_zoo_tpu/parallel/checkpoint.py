@@ -144,23 +144,42 @@ def _state_step(host_state: Any) -> Optional[int]:
         return None
 
 
+#: Snapshot tiers with their own named slot beside ``latest``/``step_N``.
+#: ``lkg`` (last-known-good) is written by the anomaly sentinel only
+#: after the health word has been clean for ``promote_after`` steps —
+#: the rollback target of the numerical-anomaly ladder.  Tier slots are
+#: deliberately NOT restore candidates for the normal resume path
+#: (``_candidates``): an LKG snapshot is typically OLDER than ``latest``
+#: and must never silently rewind an ordinary restart.
+TIERS = ("lkg",)
+
+
 def save(path: str, state: Any, step: Optional[int] = None,
          keep_last: Optional[int] = None,
-         meta: Optional[Dict[str, Any]] = None) -> str:
+         meta: Optional[Dict[str, Any]] = None,
+         tier: Optional[str] = None) -> str:
     """Save a pytree (TrainState or raw variables) atomically.
 
     ``step=None`` overwrites a single 'latest' snapshot (reference
     ``overWriteCheckpoint``); an integer publishes ``step_<step>`` and,
     with ``keep_last=N``, garbage-collects all but the newest N step
     snapshots.  ``meta`` (e.g. epoch/iteration) is recorded in the
-    manifest beside the train-state step.
+    manifest beside the train-state step.  ``tier="lkg"`` publishes into
+    the named tier slot instead (single overwrite slot per tier, same
+    atomic temp-write → manifest → rename lifecycle).
 
     Multi-host: EVERY process must call this (orbax's save has internal
     cross-process barriers); replicated leaves are read from the local
     replica so the host conversion itself never blocks on a peer."""
     from analytics_zoo_tpu.parallel.mesh import host_local_state
 
-    name = "latest" if step is None else f"step_{step}"
+    if tier is not None:
+        if tier not in TIERS:
+            raise ValueError(f"unknown checkpoint tier {tier!r}; "
+                             f"one of {TIERS}")
+        name = tier
+    else:
+        name = "latest" if step is None else f"step_{step}"
     base = os.path.abspath(path)
     target = os.path.join(base, name)
     os.makedirs(base, exist_ok=True)
@@ -185,6 +204,8 @@ def save(path: str, state: Any, step: Optional[int] = None,
     if jax.process_index() == 0:
         man_meta = {"name": name, "step": step,
                     "state_step": _state_step(host_state)}
+        if tier is not None:
+            man_meta["tier"] = tier
         man_meta.update(meta or {})
         manifest = _build_manifest(tmp, man_meta)
         with open(os.path.join(tmp, MANIFEST), "w") as f:
@@ -305,6 +326,21 @@ def newest_intact(path: str) -> Optional[Tuple[str, Dict[str, Any]]]:
         except CheckpointCorrupt:
             continue
     return None
+
+
+def lkg_snapshot(path: str) -> Optional[Tuple[str, Dict[str, Any]]]:
+    """``(snapshot_dir, manifest)`` of the last-known-good tier slot when
+    it exists AND verifies, else ``None``.  The LKG tier is tracked
+    separately from ``latest``/``step_N`` (it is not a normal resume
+    candidate); this is the anomaly ladder's rollback target."""
+    snap = os.path.join(os.path.abspath(path), "lkg")
+    if not os.path.isdir(snap):
+        return None
+    try:
+        return snap, verify_snapshot(snap)
+    except CheckpointCorrupt as e:
+        logger.warning("checkpoint: last-known-good slot unusable (%s)", e)
+        return None
 
 
 def _restore(snap_dir: str, target: Any, verify: bool) -> Any:

@@ -14,7 +14,7 @@ no JAX equivalent, so this module supplies it TPU-natively:
   run *stall* silently rather than crash).
 - :func:`run_resilient` — a restart supervisor around the
   :class:`~analytics_zoo_tpu.parallel.train.Optimizer`: on a retryable
-  failure (device/runtime error, divergence, preemption) it rebuilds the
+  failure (device/runtime error, stall, preemption) it rebuilds the
   whole program via the caller's factory and resumes from the latest
   orbax checkpoint, up to ``max_restarts`` times.  Rebuilding matters on
   TPU: after a device reset or relay drop the old compiled executables
@@ -36,22 +36,22 @@ from typing import Callable, Optional, Sequence, Tuple, Type
 
 from analytics_zoo_tpu.resilience.errors import (
     InjectedFault,
+    TrainingDiverged,
     retryable_errors,
 )
 
 logger = logging.getLogger("analytics_zoo_tpu")
 
 
-class TrainingDiverged(RuntimeError):
-    """Raised by :class:`DivergenceDetector` after a non-finite loss streak."""
-
-
 #: Failures worth restarting for: preemption, stalls, dead input
-#: pipelines, divergence, injected chaos, and jaxlib device/runtime
-#: errors.  Deliberately NOT ``RuntimeError`` — a bare RuntimeError is
-#: usually a programming error and must propagate on attempt 1.
-RETRYABLE_ERRORS: Tuple[Type[BaseException], ...] = (
-    (TrainingDiverged,) + retryable_errors())
+#: pipelines, injected chaos, and jaxlib device/runtime errors.
+#: Deliberately NOT ``RuntimeError`` — a bare RuntimeError is usually a
+#: programming error and must propagate on attempt 1.  ``TrainingDiverged``
+#: moved OUT of this tuple (resilience/errors.py classifies it fatal):
+#: restarting resumes from the same checkpoint into the same divergence,
+#: and the in-loop anomaly ladder (``resilience.anomaly``) already owns
+#: the recoverable part of that failure class.
+RETRYABLE_ERRORS: Tuple[Type[BaseException], ...] = retryable_errors()
 
 
 class DivergenceDetector:
@@ -102,10 +102,12 @@ def run_resilient(
     Returns the trained model.
 
     ``retry_on`` filters which failures are retryable; it defaults to
-    :data:`RETRYABLE_ERRORS` (preemption, stalls, divergence, device/
-    runtime errors).  Programming errors — ``TypeError``, ``ValueError``,
-    and notably *bare* ``RuntimeError`` — propagate on attempt 1 so real
-    bugs are never masked by restart churn.
+    :data:`RETRYABLE_ERRORS` (preemption, stalls, device/runtime
+    errors).  Programming errors — ``TypeError``, ``ValueError``, and
+    notably *bare* ``RuntimeError`` — propagate on attempt 1 so real
+    bugs are never masked by restart churn; ``TrainingDiverged`` is
+    likewise fatal (the in-loop anomaly ladder owns numerical recovery —
+    restarting into the same divergence cannot help).
     """
     from analytics_zoo_tpu.parallel.optim import Trigger
 

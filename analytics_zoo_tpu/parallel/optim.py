@@ -224,6 +224,10 @@ class TrainingState:
     epoch_finished: bool = False
     loss: float = float("inf")
     score: Optional[float] = None
+    #: last anomaly health word (``resilience.anomaly`` bit layout);
+    #: 0 = healthy, and always 0 when no anomaly policy is armed.  The
+    #: checkpoint guard refuses to snapshot while it is non-zero.
+    health: int = 0
 
 
 class Trigger:

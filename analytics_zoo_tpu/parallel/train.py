@@ -124,6 +124,8 @@ def make_train_step(
     grad_accum: int = 1,
     device_transform: Optional[Callable] = None,
     forward_fn: Optional[Callable] = None,
+    health_check: bool = False,
+    skip_unhealthy: bool = False,
 ):
     """Build the jitted train step.
 
@@ -143,6 +145,16 @@ def make_train_step(
     (reference ``common/nn/MultiBoxLoss.scala:546``: skip backward when
     loss > 50) — the update is zeroed when the loss exceeds the threshold,
     as a lax.cond-free masked select so the step stays a single program.
+
+    ``health_check=True`` adds the anomaly sentinel's in-graph health
+    fold (``resilience.anomaly``): one fused isfinite-and-threshold
+    reduction over the loss, the (unscaled, clipped) grads, and the
+    UPDATED params, emitted as ``metrics["health"]`` — an int32 word
+    whose per-tree-section bits name which parameter subtree went
+    non-finite (``decode_health``).  ``skip_unhealthy=True`` additionally
+    discards the whole update in-graph whenever the word is non-zero —
+    params, optimizer slots AND batch stats keep their pre-step values —
+    subsuming ``skip_loss_above`` (which becomes the word's spike bit).
 
     ``compute_dtype='bf16'`` enables mixed precision: parameters stay fp32
     masters (the optimizer update is fp32), the forward/backward runs in
@@ -255,21 +267,42 @@ def make_train_step(
         opt_state = _set_lr(state.opt_state, lr)
         updates, new_opt_state = optim.tx.update(grads, opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
-        if skip_loss_above is not None:
-            # reference guard (MultiBoxLoss.scala:546): a loss spike skips
-            # the ENTIRE update — params and optimizer state (momentum/Adam
-            # moments, counts) stay untouched, not just zeroed grads
-            keep = loss <= skip_loss_above
-            new_params = jax.tree_util.tree_map(
-                lambda new, old: jnp.where(keep, new, old),
-                new_params, state.params)
-            new_opt_state = jax.tree_util.tree_map(
-                lambda new, old: jnp.where(keep, new, old),
-                new_opt_state, opt_state)
         metrics = {"loss": loss, "lr": lr}
         # merge: mutable apply only returns the batch_stats collection; any
         # other collection in model_state must survive untouched
         merged_model_state = {**state.model_state, **new_model_state}
+        health = None
+        if health_check or skip_unhealthy:
+            from analytics_zoo_tpu.resilience import anomaly
+
+            health = anomaly.tree_health_word(
+                loss, grads, new_params,
+                anomaly.health_sections(state.params),
+                spike_loss_above=skip_loss_above)
+            metrics["health"] = health
+        def masked(keep, new, old):
+            """Elementwise select: the update applies only where ``keep``."""
+            return jax.tree_util.tree_map(
+                lambda n, o: jnp.where(keep, n, o), new, old)
+
+        if skip_unhealthy:
+            # anomaly-sentinel guard: ANY non-finite loss/grad/param (or
+            # a loss spike past skip_loss_above) discards the entire
+            # update — params, optimizer slots and batch stats keep their
+            # pre-step values, so a poison batch can never seed NaNs into
+            # the training state
+            keep = health == 0
+            new_params = masked(keep, new_params, state.params)
+            new_opt_state = masked(keep, new_opt_state, opt_state)
+            merged_model_state = masked(keep, merged_model_state,
+                                        state.model_state)
+        elif skip_loss_above is not None:
+            # reference guard (MultiBoxLoss.scala:546): a loss spike skips
+            # the ENTIRE update — params and optimizer state (momentum/Adam
+            # moments, counts) stay untouched, not just zeroed grads
+            keep = loss <= skip_loss_above
+            new_params = masked(keep, new_params, state.params)
+            new_opt_state = masked(keep, new_opt_state, opt_state)
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
@@ -457,6 +490,8 @@ class Optimizer:
         self.epoch_hook = None
         self._skip_batches = 0      # mid-epoch resume fast-forward
         self._iter_in_epoch = 0
+        self.anomaly_policy = None
+        self._anomaly = None        # AnomalySentinel, built per optimize()
 
     # -- fluent config (reference API names, snake_cased) ------------------
     def set_optim_method(self, m: OptimMethod) -> "Optimizer":
@@ -509,6 +544,22 @@ class Optimizer:
         self.stall_watchdog = watchdog
         return self
 
+    def set_anomaly_policy(self, policy=None) -> "Optimizer":
+        """Arm the training anomaly sentinel (``resilience.anomaly``):
+        the jitted step folds an in-graph health word over loss / grads /
+        updated params, unhealthy updates are discarded in-graph, and
+        the host ladder escalates — skip → rollback to the
+        last-known-good checkpoint tier (+ deterministic re-seek past
+        the bad region) → fatal ``TrainingDiverged`` after
+        ``max_rollbacks``.  A forensics bundle (``anomaly_<step>.json``)
+        is written on the first bad step of each episode; replay it with
+        ``tools/replay_batch.py``.  Rollback needs ``set_checkpoint`` so
+        the LKG tier has somewhere to live.  Costs one device→host
+        round trip per step (health word + loss fetched together)."""
+        from analytics_zoo_tpu.resilience.anomaly import AnomalyPolicy
+        self.anomaly_policy = policy or AnomalyPolicy()
+        return self
+
     def set_resume(self, path: Optional[str] = None) -> "Optimizer":
         """Resume from the latest checkpoint under ``path`` (defaults to the
         ``set_checkpoint`` path, resolved at ``optimize()`` time so the
@@ -529,7 +580,10 @@ class Optimizer:
 
     def set_failure_detector(self, detector) -> "Optimizer":
         """Periodic loss-health check (``parallel.elastic.DivergenceDetector``);
-        raises out of ``optimize()`` so a supervisor can restart."""
+        raises out of ``optimize()``.  Ignored while an anomaly policy is
+        armed: the sentinel discards bad updates in-graph, so the
+        detector would read discarded steps' NaN losses and raise fatal
+        ``TrainingDiverged`` before the ladder could roll back."""
         self.failure_detector = detector
         return self
 
@@ -549,20 +603,37 @@ class Optimizer:
             resume_base = self.resume_path or self.checkpoint_path
             if resume_base:
                 state, loop = self._try_resume(resume_base, state, loop)
-        if self.param_rules is not None:
-            from analytics_zoo_tpu.parallel import tensor as tp
-            state = tp.shard_tree(state, self.mesh, self.param_rules)
-        else:
-            state = mesh_lib.replicate(state, self.mesh)
+        state = self._place_state(state)
+        anomaly_on = self.anomaly_policy is not None
+        spike = self.skip_loss_above
+        if anomaly_on and self.anomaly_policy.spike_loss_above is not None:
+            spike = self.anomaly_policy.spike_loss_above
         train_step = make_train_step(
             self.model.module, self.criterion, self.optim,
-            mesh=self.mesh, skip_loss_above=self.skip_loss_above,
+            mesh=self.mesh, skip_loss_above=spike,
             grad_clip_norm=self.grad_clip_norm,
             compute_dtype=self.compute_dtype,
             grad_accum=self.grad_accum,
             device_transform=self.device_transform,
             forward_fn=self.forward_fn,
+            health_check=anomaly_on,
+            skip_unhealthy=anomaly_on and self.anomaly_policy.skip,
         )
+        if anomaly_on:
+            from analytics_zoo_tpu.resilience.anomaly import (
+                AnomalySentinel, health_sections)
+            self._anomaly = AnomalySentinel(
+                self.anomaly_policy,
+                sections=health_sections(
+                    mesh_lib.host_local_state(state.params)))
+            if (self.anomaly_policy.promote_initial
+                    and self.checkpoint_path is not None):
+                # seed the last-known-good tier with the (trivially
+                # healthy) starting state so a rollback ALWAYS has a
+                # target, even before the first clean-streak promotion
+                from analytics_zoo_tpu.parallel import checkpoint as ckpt
+                if ckpt.lkg_snapshot(self.checkpoint_path) is None:
+                    self._promote_lkg(loop, state)
         eval_step = make_eval_step(self.model.module,
                                    compute_dtype=self.compute_dtype)
         if self.prefetch:
@@ -596,8 +667,9 @@ class Optimizer:
                                                  self.prefetch,
                                                  close_source=True)
                                  if self.prefetch else host_iter)
+                epoch_iter = iter(epoch_batches)
                 try:
-                    for batch in epoch_batches:
+                    for batch in epoch_iter:
                         n = _batch_size(batch)
                         dev_batch = (batch if self.prefetch
                                      else mesh_lib.shard_batch(
@@ -609,14 +681,28 @@ class Optimizer:
                         loop.iteration += 1
                         self._iter_in_epoch += 1
                         records += n
-                        if (self.failure_detector is not None
-                                and self.failure_detector.should_check(
-                                    loop.iteration)):
-                            self.failure_detector.check(float(metrics["loss"]),
-                                                        loop.iteration)
                         # keep the loss as a device array — only force a host
                         # sync when something host-side actually reads it
                         loop.loss = metrics["loss"]
+                        if self._anomaly is not None:
+                            # skip / rollback / diverge ladder; may
+                            # replace `state` (rollback restores the
+                            # last-known-good tier), consume re-seek
+                            # batches from epoch_iter, and reset
+                            # loop.loss/health after a rollback
+                            state = self._anomaly_step(
+                                loop, state, metrics, dev_batch,
+                                epoch_iter)
+                        elif (self.failure_detector is not None
+                                and self.failure_detector.should_check(
+                                    loop.iteration)):
+                            # detector only when NO sentinel is armed:
+                            # the sentinel discards bad updates in-graph,
+                            # so feeding the detector a discarded step's
+                            # NaN loss would raise fatal TrainingDiverged
+                            # before the ladder could roll back
+                            self.failure_detector.check(float(metrics["loss"]),
+                                                        loop.iteration)
                         if self.train_summary is not None:
                             # device arrays on purpose: add_scalar floats them
                             # only when the tag's trigger fires
@@ -771,6 +857,192 @@ class Optimizer:
                "saved this iteration, or loss non-finite) — resume falls "
                "back to the previous snapshot"))
 
+    def _place_state(self, state: TrainState) -> TrainState:
+        """Host/state pytree → mesh placement: tensor-parallel sharding
+        rules when configured, else full replication.  The ONE placement
+        decision, shared by the initial `optimize()` setup and the
+        anomaly rollback restore so they can never drift."""
+        if self.param_rules is not None:
+            from analytics_zoo_tpu.parallel import tensor as tp
+            return tp.shard_tree(state, self.mesh, self.param_rules)
+        return mesh_lib.replicate(state, self.mesh)
+
+    # -- anomaly sentinel (resilience.anomaly ladder) ----------------------
+    def _anomaly_step(self, loop: TrainingState, state: TrainState,
+                      metrics, dev_batch, epoch_iter) -> TrainState:
+        """Per-step ladder: feed the health word to the sentinel, write
+        forensics on an episode's first bad step, roll back / escalate.
+        Returns the (possibly restored) state."""
+        from analytics_zoo_tpu.resilience import anomaly as anomaly_lib
+        from analytics_zoo_tpu.resilience.errors import TrainingDiverged
+
+        sent = self._anomaly
+        # ONE device->host round trip for both scalars (the sentinel's
+        # documented per-step host cost)
+        word, loss_host = jax.device_get((metrics["health"],
+                                          metrics["loss"]))
+        word = int(word)
+        loop.health = word
+        sent.record_loss(float(loss_host))
+        action, first = sent.observe(word)
+        if word:
+            sent.note_skip(word, step=loop.iteration)
+            logger.warning(
+                "anomaly sentinel: unhealthy step at iteration %d "
+                "(word %#x, %d consecutive): %s", loop.iteration, word,
+                sent.consecutive_bad,
+                anomaly_lib.decode_health(word, sent.sections))
+        if first:
+            self._write_forensics(sent, word, loop, state, dev_batch)
+        if action == "rollback":
+            state = self._anomaly_rollback(loop, state)
+            self._reseek(epoch_iter, sent.policy.reseek)
+        elif action == "diverged":
+            raise TrainingDiverged(
+                f"anomaly ladder exhausted at iteration {loop.iteration}: "
+                f"{sent.consecutive_bad} consecutive unhealthy steps with "
+                f"the rollback budget spent ({sent.rollbacks}/"
+                f"{sent.policy.max_rollbacks}); last health "
+                f"{anomaly_lib.decode_health(word, sent.sections)}; "
+                f"forensics bundles: {sent.forensics_paths or 'none'}")
+        elif (action == "ok" and sent.should_promote()
+                and self.checkpoint_path is not None):
+            self._promote_lkg(loop, state)
+        if word and action != "diverged" and sent.policy.skip:
+            # with in-graph skip armed the LIVE state after a bad step is
+            # provably clean (the update was discarded; a rollback just
+            # restored the promoted LKG tier) — clear the word and swap
+            # the discarded step's (usually non-finite) loss for the last
+            # finite reading, so the checkpoint guards don't refuse to
+            # persist a clean state (e.g. a preemption-forced snapshot
+            # landing inside a bad-data window).  Without skip the
+            # update DID apply, so the guards must keep refusing.
+            loop.health = 0
+            finite = [v for v in sent.loss_history if np.isfinite(v)]
+            if finite:
+                loop.loss = finite[-1]
+        return state
+
+    def _anomaly_rollback(self, loop: TrainingState,
+                          state: TrainState) -> TrainState:
+        """Restore the last-known-good tier (falling back to the newest
+        intact regular snapshot — those are health-guarded too) and
+        re-replicate it over the mesh."""
+        from analytics_zoo_tpu.parallel import checkpoint as ckpt
+        from analytics_zoo_tpu.resilience.errors import TrainingDiverged
+
+        sent = self._anomaly
+        found, tier = None, "lkg"
+        if self.checkpoint_path is not None:
+            found = ckpt.lkg_snapshot(self.checkpoint_path)
+            if found is None:
+                found, tier = ckpt.newest_intact(self.checkpoint_path), \
+                    "regular"
+        if found is None:
+            raise TrainingDiverged(
+                f"anomaly rollback requested at iteration {loop.iteration} "
+                "but no last-known-good (or intact regular) snapshot "
+                "exists — configure set_checkpoint so the ladder has a "
+                "rollback target")
+        snap_dir, man = found
+        host_target = mesh_lib.host_local_state(state)
+        restored = ckpt.load(snap_dir, target=host_target, verify=False)
+        new_state = self._place_state(restored)
+        # bit-identity proof: the live post-replication params equal the
+        # snapshot's bytes (the chaos drill banks this check)
+        live = mesh_lib.host_local_state(new_state)
+        match = all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree_util.tree_leaves(live.params),
+                            jax.tree_util.tree_leaves(restored.params)))
+        self.optim.load_state_dict(
+            (man.get("meta", {}) or {}).get("optim", {}) or {})
+        sent.note_rollback(
+            iteration=loop.iteration, tier=tier,
+            snapshot=os.path.basename(snap_dir),
+            restored_step=int(np.asarray(restored.step)),
+            params_match_snapshot=bool(match),
+            reseek_batches=sent.policy.reseek)
+        logger.warning(
+            "anomaly sentinel: rollback %d/%d at iteration %d -> %s "
+            "(restored step %d, params bit-identical to snapshot: %s)",
+            sent.rollbacks, sent.policy.max_rollbacks, loop.iteration,
+            snap_dir, int(np.asarray(restored.step)), match)
+        return new_state
+
+    def _reseek(self, epoch_iter, n: int) -> None:
+        """Advance the deterministic stream past the bad region: drop the
+        next ``n`` batches on the host (they count as consumed for the
+        mid-epoch-resume position, but train no step)."""
+        done = object()
+        skipped = 0
+        for _ in range(max(n, 0)):
+            if next(epoch_iter, done) is done:
+                break
+            skipped += 1
+            self._iter_in_epoch += 1
+        if skipped:
+            logger.warning("anomaly sentinel: re-sought stream past %d "
+                           "batch(es) after rollback", skipped)
+
+    def _write_forensics(self, sent, word: int, loop: TrainingState,
+                         state: TrainState, dev_batch) -> None:
+        from analytics_zoo_tpu.resilience import anomaly as anomaly_lib
+
+        directory = (sent.policy.forensics_dir or self.checkpoint_path
+                     or os.getcwd())
+        batch_in_epoch = self._iter_in_epoch - 1
+        num_workers = getattr(self.dataset, "num_workers", None)
+        group_size = getattr(self.dataset, "group_size", None)
+        # worker shards owning the groups this batch spans (a batch is
+        # assembled in the parent from one or MORE groups; assumes no
+        # upstream sample drops shifted the mapping).  Replay itself
+        # needs only (base_seed, epoch, batch index).
+        worker_shards = None
+        if num_workers and group_size:
+            B = _batch_size(dev_batch)
+            first = (batch_in_epoch * B) // group_size
+            last = ((batch_in_epoch + 1) * B - 1) // group_size
+            worker_shards = sorted({g % num_workers
+                                    for g in range(first, last + 1)})
+        payload = {
+            "bundle": "anomaly_forensics",
+            "format": 1,
+            "step": int(np.asarray(mesh_lib.host_local_state(state.step))),
+            "iteration": loop.iteration,
+            "epoch": loop.epoch,
+            "batch_in_epoch": batch_in_epoch,
+            "health_word": int(word),
+            "health": anomaly_lib.decode_health(word, sent.sections),
+            "sections": sent.sections,
+            "batch_hash": anomaly_lib.batch_fingerprint(dev_batch),
+            # strict-JSON loss history: non-finite floats become strings
+            "loss_history": [v if np.isfinite(v) else repr(v)
+                             for v in sent.loss_history],
+            # the PR-2 determinism coordinates replay_batch.py consumes
+            "rng": {
+                "base_seed": getattr(self.dataset, "base_seed", None),
+                "loader_epoch": getattr(self.dataset, "last_epoch", None),
+                "num_workers": num_workers,
+                "worker_shards": worker_shards,
+            },
+        }
+        sent.write_forensics(directory, payload)
+
+    def _promote_lkg(self, loop: TrainingState, state: TrainState) -> None:
+        from analytics_zoo_tpu.parallel import checkpoint as ckpt
+
+        target = ckpt.save(
+            self.checkpoint_path, state, tier="lkg",
+            meta={"epoch": loop.epoch, "iteration": loop.iteration,
+                  "iter_in_epoch": self._iter_in_epoch,
+                  "health_word": 0,
+                  "optim": self.optim.state_dict()})
+        self._anomaly.note_promoted(step=loop.iteration,
+                                    snapshot=os.path.basename(target))
+        logger.info("anomaly sentinel: promoted last-known-good snapshot "
+                    "at iteration %d", loop.iteration)
+
     def _maybe_checkpoint(self, loop: TrainingState, state: TrainState,
                           force: bool = False) -> bool:
         """Returns True when this iteration's state is persisted (saved
@@ -780,13 +1052,16 @@ class Optimizer:
             return False
         if getattr(self, "_last_ckpt_iter", None) == loop.iteration:
             return True
-        # never snapshot a poisoned state: a non-finite loss means the
-        # params may already be NaN, and overwriting 'latest' with them
-        # would make every elastic restart resume the divergence
+        # never snapshot a poisoned state: the anomaly health word covers
+        # non-finite GRADS/PARAMS even when this step's scalar loss is
+        # finite; the loss check alone remains the guard for runs without
+        # an anomaly policy (loop.health then stays 0)
         loss_now = float(loop.loss)
-        if not np.isfinite(loss_now):
+        health_now = int(getattr(loop, "health", 0) or 0)
+        if health_now or not np.isfinite(loss_now):
             logger.warning("skipping checkpoint at iteration %d: "
-                           "loss is %s", loop.iteration, loss_now)
+                           "health word %#x, loss %s", loop.iteration,
+                           health_now, loss_now)
             return False
         # memoized only on an ACTUAL save: a skipped save must not make a
         # later forced call at this iteration report "already persisted"

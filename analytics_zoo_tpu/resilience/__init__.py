@@ -5,10 +5,14 @@ The reference delegated its whole failure story to Spark task retry and
 lineage (``ssd/example/Train.scala:153``); a TPU-native system owns it
 itself.  The pieces (see docs/RESILIENCE.md):
 
-- :mod:`errors` — retryable vs fatal taxonomy (:func:`retryable_errors`)
+- :mod:`errors` — retryable vs fatal taxonomy (:func:`retryable_errors`,
+  :data:`FATAL_ERRORS`, :func:`is_retryable`)
 - :mod:`watchdog` — :class:`StallWatchdog` (hung step → StallError)
 - :mod:`preempt` — :class:`PreemptionHandler` (SIGTERM → checkpoint →
   Preempted)
+- :mod:`anomaly` — the numerical-anomaly sentinel: in-graph health word,
+  skip → rollback-to-last-known-good → ``TrainingDiverged`` ladder,
+  deterministic bad-batch forensics (``tools/replay_batch.py``)
 - :mod:`chaos` — :class:`ChaosMonkey` fault matrix + ``tools/chaos_drill``
 - atomic/verified snapshots live in :mod:`analytics_zoo_tpu.parallel.
   checkpoint`; the restart supervisor in :mod:`analytics_zoo_tpu.
@@ -16,16 +20,26 @@ itself.  The pieces (see docs/RESILIENCE.md):
 """
 
 from analytics_zoo_tpu.resilience.errors import (
+    FATAL_ERRORS,
     CheckpointCorrupt,
     InjectedFault,
     Preempted,
     PrefetchWorkerDied,
     ShardReadError,
     StallError,
+    TrainingDiverged,
+    is_retryable,
     retryable_errors,
 )
 from analytics_zoo_tpu.resilience.watchdog import StallWatchdog
 from analytics_zoo_tpu.resilience.preempt import PreemptionHandler
+from analytics_zoo_tpu.resilience.anomaly import (
+    AnomalyPolicy,
+    AnomalySentinel,
+    batch_fingerprint,
+    decode_health,
+    health_sections,
+)
 from analytics_zoo_tpu.resilience.chaos import (
     ChaosMonkey,
     FaultSpec,

@@ -1,0 +1,327 @@
+"""Training anomaly sentinel: in-graph numerical health + the host ladder.
+
+The reference's numerical failure story is one scalar guard — MultiBoxLoss
+skips backward when the loss exceeds 50 (``MultiBoxLoss.scala:546``,
+ported as ``make_train_step(skip_loss_above=...)``) — and a checkpoint
+skip once the logged loss is *already* NaN.  By then the params and
+optimizer slots may have been poisoned for hundreds of steps.  This
+module supplies the production ladder instead (mixed-precision practice
+à la Micikevicius et al.; large-run logbooks treat non-finite steps as
+routine, not fatal):
+
+1. **Health word** — ``make_train_step(health_check=True)`` folds ONE
+   fused ``isfinite``-and-threshold reduction over the loss, the grads,
+   and the *updated* params into a single int32 scalar per step (cheap
+   on TPU: a handful of ANDs over values already in registers, one extra
+   all-reduce word).  Per-tree-section bits name WHICH top-level
+   parameter subtree went non-finite — see :func:`decode_health`.
+2. **Skip** — ``skip_unhealthy=True`` discards the whole update in-graph
+   (params, optimizer slots AND batch stats keep their pre-step values)
+   whenever the word is non-zero, subsuming the scalar
+   ``skip_loss_above`` guard (which becomes the word's spike bit).
+3. **Rollback** — :class:`AnomalySentinel` (driven by the Optimizer
+   loop) counts consecutive bad steps; at ``rollback_after`` it restores
+   the **last-known-good** checkpoint tier (promoted only after
+   ``promote_after`` consecutive clean steps — ``parallel.checkpoint``
+   ``tier="lkg"``) and re-seeks the deterministic loader past the bad
+   region.
+4. **Diverged** — after ``max_rollbacks`` rollbacks the run raises
+   :class:`~analytics_zoo_tpu.resilience.errors.TrainingDiverged`
+   (fatal, NOT retried: a blind restart would resume into the same
+   divergence).
+
+On the first bad step of an episode a **forensics bundle**
+(``anomaly_<step>.json``) records the batch coordinates under the PR-2
+determinism contract — ``(base_seed, epoch, batch index)`` — plus the
+decoded health word, a content hash of the offending batch, and the
+recent loss history; ``tools/replay_batch.py`` re-materializes that
+exact batch and re-runs one step in float32 to classify data-vs-
+optimization causes.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+# ---------------------------------------------------------------------------
+# Health word layout (int32 scalar; 0 == healthy)
+# ---------------------------------------------------------------------------
+
+#: bit 0: loss non-finite; bit 1: loss spike (> threshold); bit 2: any
+#: grad non-finite; bit 3: any updated param non-finite; bits 4+2i /
+#: 5+2i: grads / params of tree section i non-finite.  Sections are the
+#: sorted top-level keys of the params tree; sections beyond
+#: ``MAX_SECTIONS`` fold into the last pair so the word stays one int32.
+BIT_LOSS_NONFINITE = 0
+BIT_LOSS_SPIKE = 1
+BIT_GRADS_NONFINITE = 2
+BIT_PARAMS_NONFINITE = 3
+_SECTION_BIT0 = 4
+MAX_SECTIONS = 13          # 4 + 2*13 = 30 bits used, sign bit untouched
+
+
+def health_sections(params: Any) -> List[str]:
+    """Stable section names for a params tree: its sorted top-level keys
+    (one section for a non-mapping tree).  Traced and decoded with the
+    SAME list, so the per-section bits are meaningful on the host."""
+    if isinstance(params, Mapping) and len(params):
+        return sorted(str(k) for k in params.keys())
+    return ["params"]
+
+
+def _section_bit(i: int, kind: str) -> int:
+    i = min(i, MAX_SECTIONS - 1)
+    return _SECTION_BIT0 + 2 * i + (0 if kind == "grads" else 1)
+
+
+def tree_health_word(loss, grads, new_params, sections: Sequence[str],
+                     spike_loss_above: Optional[float] = None):
+    """Traced: fold loss/grads/params finiteness into one int32 scalar.
+
+    Runs INSIDE the jitted train step — every reduction fuses with the
+    update computation, and on a mesh the scalar replicates with the
+    loss (one extra word on the existing all-reduce).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def tree_bad(tree) -> Any:
+        """True when any inexact leaf holds a non-finite value."""
+        bad = jnp.zeros((), jnp.bool_)
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+                bad = bad | ~jnp.all(jnp.isfinite(leaf))
+        return bad
+
+    def as_map(tree) -> Mapping:
+        return tree if isinstance(tree, Mapping) else {"params": tree}
+
+    gmap, pmap = as_map(grads), as_map(new_params)
+    word = jnp.zeros((), jnp.int32)
+
+    def set_bit(word, flag, bit):
+        return word | (flag.astype(jnp.int32) << bit)
+
+    word = set_bit(word, ~jnp.isfinite(loss), BIT_LOSS_NONFINITE)
+    if spike_loss_above is not None:
+        # isfinite-AND-threshold in one fold: a spike only counts when
+        # the loss is finite (non-finite already has its own bit)
+        spike = jnp.isfinite(loss) & (loss > spike_loss_above)
+        word = set_bit(word, spike, BIT_LOSS_SPIKE)
+    any_g = jnp.zeros((), jnp.bool_)
+    any_p = jnp.zeros((), jnp.bool_)
+    for i, name in enumerate(sections):
+        g_bad = tree_bad(gmap.get(name))
+        p_bad = tree_bad(pmap.get(name))
+        word = set_bit(word, g_bad, _section_bit(i, "grads"))
+        word = set_bit(word, p_bad, _section_bit(i, "params"))
+        any_g, any_p = any_g | g_bad, any_p | p_bad
+    word = set_bit(word, any_g, BIT_GRADS_NONFINITE)
+    word = set_bit(word, any_p, BIT_PARAMS_NONFINITE)
+    return word
+
+
+def decode_health(word: int, sections: Sequence[str]) -> Dict[str, Any]:
+    """Host-side report for a health word: names the failing subtrees."""
+    word = int(word)
+    out: Dict[str, Any] = {
+        "healthy": word == 0,
+        "loss_nonfinite": bool(word >> BIT_LOSS_NONFINITE & 1),
+        "loss_spike": bool(word >> BIT_LOSS_SPIKE & 1),
+        "grads_nonfinite": bool(word >> BIT_GRADS_NONFINITE & 1),
+        "params_nonfinite": bool(word >> BIT_PARAMS_NONFINITE & 1),
+        "bad_sections": {},
+    }
+    for i, name in enumerate(sections):
+        g = bool(word >> _section_bit(i, "grads") & 1)
+        p = bool(word >> _section_bit(i, "params") & 1)
+        if g or p:
+            out["bad_sections"][name] = {"grads": g, "params": p}
+    return out
+
+
+def batch_fingerprint(batch: Any) -> str:
+    """Content hash of a (possibly device-resident) batch pytree —
+    key-ordered, dtype/shape-tagged blake2s over the raw bytes.  The
+    forensics bundle records it; ``tools/replay_batch.py`` asserts the
+    re-materialized batch matches byte for byte."""
+    import jax
+
+    h = hashlib.blake2s()
+    leaves = jax.tree_util.tree_flatten_with_path(batch)[0]
+    for path, leaf in leaves:
+        arr = np.asarray(leaf)
+        h.update(jax.tree_util.keystr(path).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(repr(arr.shape).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Policy + sentinel (host side)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class AnomalyPolicy:
+    """Knobs for the skip → rollback → diverge ladder.
+
+    ``skip`` discards unhealthy updates in-graph.  ``rollback_after``
+    consecutive bad steps restore the last-known-good tier;
+    ``reseek_batches`` (default: ``rollback_after``) deterministic
+    batches are then skipped so the stream clears the bad region before
+    stepping resumes.  The LKG tier is promoted after ``promote_after``
+    consecutive clean steps (and at most every ``promote_after`` steps).
+    ``max_rollbacks`` exceeded raises ``TrainingDiverged`` (fatal).
+    ``spike_loss_above`` arms the health word's loss-spike bit.
+    """
+
+    skip: bool = True
+    rollback_after: int = 3
+    promote_after: int = 20
+    max_rollbacks: int = 2
+    reseek_batches: Optional[int] = None
+    spike_loss_above: Optional[float] = None
+    promote_initial: bool = True
+    loss_history: int = 64
+    forensics_dir: Optional[str] = None
+
+    def __post_init__(self):
+        if self.rollback_after < 1:
+            raise ValueError("rollback_after must be >= 1")
+        if self.promote_after < 1:
+            raise ValueError("promote_after must be >= 1")
+        if self.max_rollbacks < 0:
+            raise ValueError("max_rollbacks must be >= 0")
+
+    @property
+    def reseek(self) -> int:
+        return (self.rollback_after if self.reseek_batches is None
+                else self.reseek_batches)
+
+
+class AnomalySentinel:
+    """Host-side state machine over per-step health words.
+
+    The Optimizer feeds it one word per step; it answers with the action
+    to take (``ok`` / ``skipped`` / ``rollback`` / ``diverged``) and
+    keeps the deterministic event log + loss history the forensics
+    bundle and the chaos drill read.
+    """
+
+    def __init__(self, policy: AnomalyPolicy, sections: Sequence[str]):
+        self.policy = policy
+        self.sections = list(sections)
+        self.consecutive_bad = 0
+        self.clean_streak = 0
+        self.bad_steps = 0
+        self.skipped = 0
+        self.spike_skips = 0
+        self.rollbacks = 0
+        self.promotions = 0
+        self._since_promote: Optional[int] = None
+        self.events: List[Dict[str, Any]] = []
+        self.loss_history: collections.deque = collections.deque(
+            maxlen=policy.loss_history)
+        self.forensics_paths: List[str] = []
+
+    # -- per-step ----------------------------------------------------------
+    def record_loss(self, loss: float) -> None:
+        self.loss_history.append(float(loss))
+
+    def observe(self, word: int) -> Tuple[str, bool]:
+        """Feed one health word; returns ``(action, first_detection)``.
+        ``first_detection`` is True exactly on the clean→bad transition
+        of an episode (the forensics-bundle moment).
+
+        A word carrying ONLY the loss-spike bit keeps the reference
+        guard's semantics — skip the update, nothing more: finite
+        spikes are routine early training (the reason MultiBoxLoss
+        merely skips), so they never count toward the rollback ladder
+        and never trigger forensics.  They do reset the clean streak,
+        so the LKG tier is not promoted mid-spike-burst."""
+        if self._since_promote is not None:
+            self._since_promote += 1
+        if word == 0:
+            self.consecutive_bad = 0
+            self.clean_streak += 1
+            return "ok", False
+        self.clean_streak = 0
+        self.bad_steps += 1
+        if self.policy.skip:
+            self.skipped += 1
+        if word == (1 << BIT_LOSS_SPIKE):
+            self.spike_skips += 1
+            return "skipped", False
+        first = self.consecutive_bad == 0
+        self.consecutive_bad += 1
+        if self.consecutive_bad >= self.policy.rollback_after:
+            if self.rollbacks >= self.policy.max_rollbacks:
+                return "diverged", first
+            return "rollback", first
+        return "skipped", first
+
+    # -- ladder bookkeeping ------------------------------------------------
+    def should_promote(self) -> bool:
+        """Promote the LKG tier when the word has been clean for
+        ``promote_after`` consecutive steps, throttled so a long clean
+        run re-promotes at most every ``promote_after`` steps."""
+        if self.clean_streak < self.policy.promote_after:
+            return False
+        return (self._since_promote is None
+                or self._since_promote >= self.policy.promote_after)
+
+    def note_promoted(self, step: int, snapshot: str) -> None:
+        self.promotions += 1
+        self._since_promote = 0
+        self.events.append({"kind": "lkg_promoted", "step": int(step),
+                            "snapshot": snapshot})
+
+    def note_rollback(self, **detail: Any) -> None:
+        self.rollbacks += 1
+        self.consecutive_bad = 0
+        self.clean_streak = 0
+        self._since_promote = None   # re-promote only after a fresh streak
+        self.events.append({"kind": "rollback",
+                            "rollback_index": self.rollbacks, **detail})
+
+    def note_skip(self, word: int, step: int) -> None:
+        self.events.append({"kind": "skip", "step": int(step),
+                            "health_word": int(word),
+                            "consecutive": self.consecutive_bad})
+
+    # -- forensics ---------------------------------------------------------
+    def write_forensics(self, directory: str,
+                        payload: Dict[str, Any]) -> str:
+        """Dump ``anomaly_<step>.json`` (payload must carry ``step``).
+        Returns the path; also recorded in :attr:`forensics_paths`."""
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, f"anomaly_{payload['step']}.json")
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        self.forensics_paths.append(path)
+        self.events.append({"kind": "forensics",
+                            "path": os.path.basename(path),
+                            "step": payload["step"],
+                            "health_word": payload.get("health_word")})
+        logger.warning("anomaly sentinel: forensics bundle written to %s "
+                       "(health word %s)", path, payload.get("health_word"))
+        return path
+
+    def stats(self) -> Dict[str, Any]:
+        return {"bad_steps": self.bad_steps, "skipped": self.skipped,
+                "spike_skips": self.spike_skips,
+                "rollbacks": self.rollbacks, "promotions": self.promotions,
+                "forensics_bundles": len(self.forensics_paths)}

@@ -19,7 +19,22 @@ kind                 effect
 ``corrupt_latest``   truncate a manifest-listed file of the newest intact
                      snapshot on disk (restore must fall back)
 ``stall``            sleep past the StallWatchdog deadline (hung step)
+``nan_grads``        poison the batch input with a NaN — loss/grads go
+                     non-finite (the anomaly sentinel must skip)
+``inf_loss``         blow the batch target up so the loss overflows to
+                     inf (spike/overflow path of the health word)
+``corrupt_batch``    deterministically scramble the input payload's raw
+                     bytes (a corrupt record surviving decode)
 ===================  ======================================================
+
+The last three are *numerical* faults: instead of raising, they MUTATE
+the yielded batch (deterministically — the scramble RNG is seeded from
+the global batch index, so ``tools/replay_batch.py`` can re-apply the
+exact corruption during forensics replay).  ``FaultSpec(batches=N)``
+stretches a numerical fault over N consecutive batches — one batch
+exercises the sentinel's skip, ``rollback_after`` consecutive force a
+rollback, and a persistent window drives the ladder to
+``TrainingDiverged``.
 
 The schedule is plain data (:class:`FaultSpec` list), so drills can build
 it from a seeded RNG and stay deterministic.  The monkey's batch counter
@@ -40,12 +55,64 @@ import signal as _signal
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from analytics_zoo_tpu.resilience.errors import InjectedFault
 
 logger = logging.getLogger("analytics_zoo_tpu")
 
+#: kinds that MUTATE the yielded batch instead of raising/killing
+NUMERICAL_KINDS = ("nan_grads", "inf_loss", "corrupt_batch")
+
 KINDS = ("crash", "xla_transient", "sigterm", "mid_save_kill",
-         "corrupt_latest", "stall")
+         "corrupt_latest", "stall") + NUMERICAL_KINDS
+
+
+def _poison_leaf(batch: Dict[str, Any], key: str) -> np.ndarray:
+    """Copy-on-write float leaf under ``batch[key]`` (first element of a
+    tuple/list input).  The caller's batch is never mutated in place —
+    the same host arrays may be re-yielded on a later epoch."""
+    val = batch[key]
+    if isinstance(val, (tuple, list)):
+        arr = np.array(np.asarray(val[0]), copy=True)
+        rest = list(val)[1:]
+        batch[key] = type(val)([arr] + rest) if isinstance(val, list) \
+            else (arr,) + tuple(rest)
+    else:
+        arr = np.array(np.asarray(val), copy=True)
+        batch[key] = arr
+    if not np.issubdtype(arr.dtype, np.floating):
+        raise TypeError(f"numerical chaos needs a float leaf at "
+                        f"batch[{key!r}], got {arr.dtype}")
+    return arr
+
+
+def mutate_batch(kind: str, batch: Dict[str, Any], seed: int) -> Dict[str, Any]:
+    """Apply one numerical fault to a batch, deterministically.
+
+    ``seed`` is the batch's GLOBAL stream index by convention: replaying
+    the same (kind, seed) on the same clean batch reproduces the
+    corrupted payload byte for byte (the forensics replay contract).
+    Returns a shallow copy; poisoned leaves are fresh arrays."""
+    if kind not in NUMERICAL_KINDS:
+        raise ValueError(f"not a numerical fault kind: {kind!r}")
+    if not isinstance(batch, dict):
+        raise TypeError("numerical chaos kinds need dict batches")
+    out = dict(batch)
+    if kind == "nan_grads":
+        arr = _poison_leaf(out, "input")
+        arr.reshape(-1)[0] = np.nan
+    elif kind == "inf_loss":
+        key = "target" if "target" in out else "input"
+        arr = _poison_leaf(out, key)
+        # large-but-representable: the squared error overflows f32 → inf
+        arr.reshape(-1)[0] = np.asarray(1e30, arr.dtype)
+    else:  # corrupt_batch: scramble the payload's raw bytes
+        arr = _poison_leaf(out, "input")
+        rng = np.random.Generator(np.random.PCG64(seed & 0xFFFFFFFFFFFFFFFF))
+        flat = arr.view(np.uint8).reshape(-1)
+        flat[:] = flat[rng.permutation(flat.size)]
+    return out
 
 
 def transient_xla_error(msg: str = "injected transient device error"):
@@ -88,15 +155,24 @@ def corrupt_snapshot(checkpoint_path: str) -> Tuple[str, str]:
 class FaultSpec:
     """One scheduled fault: ``kind`` fires just before the wrapped
     dataset yields global batch index ``at_batch`` (counted across epochs
-    AND restart attempts)."""
+    AND restart attempts).  Numerical kinds may stretch over ``batches``
+    consecutive batches (``[at_batch, at_batch + batches)``) — the knob
+    that distinguishes a one-off bad record (skip), a bad burst
+    (rollback) and persistent divergence (``TrainingDiverged``)."""
 
     kind: str
     at_batch: int
+    batches: int = 1
 
     def __post_init__(self):
         if self.kind not in KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r}; "
                              f"one of {KINDS}")
+        if self.batches < 1:
+            raise ValueError("batches must be >= 1")
+        if self.batches > 1 and self.kind not in NUMERICAL_KINDS:
+            raise ValueError(f"batches>1 only applies to numerical kinds "
+                             f"{NUMERICAL_KINDS}, not {self.kind!r}")
 
 
 class ChaosMonkey:
@@ -129,17 +205,35 @@ class ChaosMonkey:
 
     def _due(self) -> List[int]:
         return [i for i, f in enumerate(self.faults)
-                if not self._fired[i] and f.at_batch <= self.consumed]
+                if not self._fired[i] and f.at_batch <= self.consumed
+                and f.kind not in NUMERICAL_KINDS]
 
-    def on_batch(self) -> None:
-        """Fire every due fault (called by the wrapper before each yield).
-        Raising kinds record first, then raise."""
+    def on_batch(self, batch=None):
+        """Fire every due fault (called by the wrapper before each yield)
+        and apply any numerical fault whose window covers this batch to
+        ``batch``.  Raising kinds record first, then raise.  Returns the
+        (possibly mutated) batch."""
         for i in self._due():
             self._fired[i] = True
             f = self.faults[i]
             logger.warning("chaos: firing %s at batch %d", f.kind,
                            self.consumed)
             getattr(self, f"_fire_{f.kind}")(f, i)
+        for i, f in enumerate(self.faults):
+            if f.kind not in NUMERICAL_KINDS or self._fired[i]:
+                continue
+            if not (f.at_batch <= self.consumed < f.at_batch + f.batches):
+                continue
+            logger.warning("chaos: %s poisoning batch %d (window %d..%d)",
+                           f.kind, self.consumed, f.at_batch,
+                           f.at_batch + f.batches - 1)
+            # seed = global batch index: forensics replay re-applies the
+            # identical corruption to the re-materialized clean batch
+            batch = mutate_batch(f.kind, batch, seed=self.consumed)
+            self._record(f, scheduled_at=f.at_batch, seed=self.consumed)
+            if self.consumed >= f.at_batch + f.batches - 1:
+                self._fired[i] = True
+        return batch
 
     def _record(self, f: FaultSpec, **detail) -> None:
         self.events.append({"kind": f.kind, "at_batch": self.consumed,
@@ -231,7 +325,10 @@ class ChaosMonkey:
 
 
 class ChaosDataset:
-    """Re-iterable dataset wrapper bound to a :class:`ChaosMonkey`."""
+    """Re-iterable dataset wrapper bound to a :class:`ChaosMonkey`.
+    Unknown attributes delegate to the wrapped dataset, so loader
+    metadata (``base_seed``, ``last_epoch``, ``num_workers`` — the
+    anomaly-forensics RNG coordinates) stays visible through the wrap."""
 
     def __init__(self, monkey: ChaosMonkey, ds):
         self.monkey = monkey
@@ -239,9 +336,12 @@ class ChaosDataset:
 
     def __iter__(self):
         for batch in self.ds:
-            self.monkey.on_batch()
+            batch = self.monkey.on_batch(batch)
             self.monkey.consumed += 1
             yield batch
 
     def __len__(self):
         return len(self.ds)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["ds"], name)

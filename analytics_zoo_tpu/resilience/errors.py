@@ -71,14 +71,44 @@ class InjectedFault(RuntimeError):
     lost device or killed task, so it counts as retryable."""
 
 
+class TrainingDiverged(RuntimeError):
+    """Numerical recovery is exhausted: the anomaly ladder (skip the
+    step → roll back to the last-known-good snapshot → re-seek past the
+    bad region) was climbed to its top and the run STILL produces
+    non-finite losses/grads/params — or no last-known-good snapshot
+    exists to roll back to.  Fatal by design: a blind restart would
+    resume from the same checkpoint into the same divergence, so the
+    supervisor must NOT retry; a human (armed with the forensics bundle
+    ``anomaly_<step>.json`` and ``tools/replay_batch.py``) decides what
+    changes.  Also raised by the legacy
+    :class:`~analytics_zoo_tpu.parallel.elastic.DivergenceDetector`
+    after a non-finite loss streak."""
+
+
+#: Explicit classification registries.  EVERY exception class defined in
+#: this module must appear in exactly one of the two tuples below — the
+#: taxonomy completeness test (tests/test_anomaly.py) enforces it, so a
+#: future error class cannot silently fall through ``run_resilient``'s
+#: retry filter with unconsidered semantics.
+_RETRYABLE_CLASSES: Tuple[Type[BaseException], ...] = (
+    Preempted,
+    StallError,
+    PrefetchWorkerDied,
+    InjectedFault,
+)
+
+#: Fatal: restarting cannot fix these (no intact snapshot left; a shard
+#: that stays unreadable; a run whose numerics keep diverging).
+FATAL_ERRORS: Tuple[Type[BaseException], ...] = (
+    CheckpointCorrupt,
+    ShardReadError,
+    TrainingDiverged,
+)
+
+
 def retryable_errors() -> Tuple[Type[BaseException], ...]:
     """The canonical tuple of transient, restart-recoverable failures."""
-    errs: Tuple[Type[BaseException], ...] = (
-        Preempted,
-        StallError,
-        PrefetchWorkerDied,
-        InjectedFault,
-    )
+    errs = _RETRYABLE_CLASSES
     try:  # transient device/runtime errors (lost TPU, relay drop, OOM)
         import jaxlib.xla_extension as _xe
 
@@ -86,3 +116,12 @@ def retryable_errors() -> Tuple[Type[BaseException], ...]:
     except Exception:  # pragma: no cover - jaxlib always present in-image
         pass
     return errs
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Classify one failure instance against the taxonomy.  Fatal classes
+    win over retryable bases (``TrainingDiverged`` is a ``RuntimeError``
+    subclass, but divergence must never be restart-masked)."""
+    if isinstance(exc, FATAL_ERRORS):
+        return False
+    return isinstance(exc, retryable_errors())

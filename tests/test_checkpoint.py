@@ -200,3 +200,57 @@ class TestPathResolution:
         assert not ckpt.has_checkpoint(base)
         ckpt.save(base, _tree(1.0))
         assert ckpt.has_checkpoint(base)
+
+
+class TestLkgTier:
+    """Last-known-good tier (the anomaly ladder's rollback target):
+    its own overwrite slot, tracked SEPARATELY from latest/step_N."""
+
+    def test_save_and_verify_lkg(self, tmp_path):
+        base = str(tmp_path / "c")
+        t = ckpt.save(base, _tree(1.5), tier="lkg",
+                      meta={"iteration": 9, "health_word": 0})
+        assert os.path.basename(t) == "lkg"
+        snap, man = ckpt.lkg_snapshot(base)
+        assert snap == t
+        assert man["meta"]["tier"] == "lkg"
+        assert man["meta"]["iteration"] == 9
+        out = ckpt.load(snap)
+        assert float(out["w"][0, 0]) == 1.5
+
+    def test_lkg_overwrites_atomically(self, tmp_path):
+        base = str(tmp_path / "c")
+        ckpt.save(base, _tree(1.0), tier="lkg")
+        ckpt.save(base, _tree(2.0), tier="lkg")
+        snap, _ = ckpt.lkg_snapshot(base)
+        assert float(ckpt.load(snap)["w"][0, 0]) == 2.0
+
+    def test_lkg_is_not_a_regular_resume_candidate(self, tmp_path):
+        """An (older) LKG snapshot must never outrank or even compete
+        with latest/step_N on the normal restore path."""
+        base = str(tmp_path / "c")
+        ckpt.save(base, _tree(1.0), tier="lkg")
+        ckpt.save(base, _tree(9.0), step=3)
+        out = ckpt.load(base)
+        assert float(out["w"][0, 0]) == 9.0
+        d, _ = ckpt.newest_intact(base)
+        assert os.path.basename(d) == "step_3"
+        # and an LKG-only tree is invisible to has_checkpoint
+        base2 = str(tmp_path / "only_lkg")
+        ckpt.save(base2, _tree(1.0), tier="lkg")
+        assert not ckpt.has_checkpoint(base2)
+        assert ckpt.lkg_snapshot(base2) is not None
+
+    def test_corrupt_lkg_returns_none(self, tmp_path):
+        base = str(tmp_path / "c")
+        t = ckpt.save(base, _tree(1.0), tier="lkg")
+        man = ckpt.read_manifest(t)
+        rel = max(man["files"], key=lambda r: man["files"][r]["size"])
+        full = os.path.join(t, rel)
+        with open(full, "r+b") as f:
+            f.truncate(os.path.getsize(full) // 2)
+        assert ckpt.lkg_snapshot(base) is None
+
+    def test_unknown_tier_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="unknown checkpoint tier"):
+            ckpt.save(str(tmp_path / "c"), _tree(1.0), tier="bogus")

@@ -11,6 +11,7 @@ import textwrap
 
 import cv2
 import numpy as np
+import pytest
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 
@@ -183,3 +184,79 @@ class TestChaosDrillHelpers:
         assert out["retries"] == out["injected_transient_errors"] == 2
         assert out["skipped_records"] == 1
         assert out["records_read"] == out["records_written"] - 1
+
+
+class TestAnomalyDrillHelpers:
+    """Fast pieces of the r02 anomaly ladder drill (the full drill is
+    the committed RESILIENCE_r02.json execution)."""
+
+    def test_anomaly_schedule_seeded_deterministic(self):
+        import random
+
+        from tools.chaos_drill import build_anomaly_schedule
+
+        a = build_anomaly_schedule(random.Random(5), rollback_after=3)
+        b = build_anomaly_schedule(random.Random(5), rollback_after=3)
+        assert [(f.kind, f.at_batch, f.batches) for f in a] == \
+               [(f.kind, f.at_batch, f.batches) for f in b]
+        kinds = [f.kind for f in a]
+        assert kinds == ["nan_grads", "nan_grads", "corrupt_batch"]
+        # one isolated batch, one exactly-K burst, one persistent window
+        assert a[0].batches == 1 and a[1].batches == 3
+        assert a[2].batches > 100
+        # windows are disjoint and ordered
+        assert a[0].at_batch < a[1].at_batch
+        assert a[1].at_batch + a[1].batches <= a[2].at_batch
+
+    def test_replay_batches_contract(self):
+        import numpy as np
+
+        from analytics_zoo_tpu.data.dataset import DataSet
+        from analytics_zoo_tpu.data.parallel import replay_batches
+        from analytics_zoo_tpu.resilience.anomaly import batch_fingerprint
+
+        rng = np.random.RandomState(0)
+        X = rng.randn(24, 4).astype(np.float32)
+        Y = rng.randn(24, 1).astype(np.float32)
+
+        def fresh():
+            return (DataSet.from_arrays(input=X, target=Y)
+                    .batch(8).parallel(0, base_seed=3))
+
+        # live pass over epoch 0 then epoch 1
+        loader = fresh()
+        epochs = [list(loader), list(loader)]
+        assert loader.last_epoch == 1
+        for ep in (0, 1):
+            got = replay_batches(fresh(), ep, [0, 2])
+            for i in (0, 2):
+                assert batch_fingerprint(got[i]) == \
+                    batch_fingerprint(epochs[ep][i]), (ep, i)
+        with pytest.raises(ValueError, match="ended before"):
+            replay_batches(fresh(), 0, [99])
+
+
+class TestIngestRealFixture:
+    def test_smoke_alexnet_end_to_end(self, tmp_path):
+        """Satellite: wire tools/ingest_real.py into the suite — the
+        reduced (SSD-AlexNet) smoke runs devkit→get_pascal→shards→train
+        →VOC07-mAP in-process; the committed REAL_DATA.json is the
+        banked SSD-VGG execution of the same command."""
+        import json
+
+        from tools import ingest_real
+
+        out = str(tmp_path / "REAL_DATA.json")
+        rc = ingest_real.main(["--smoke", "--arch", "alexnet",
+                               "--batch", "8", "--epochs", "1",
+                               "--num-shards", "2", "--out", out])
+        assert rc == 0
+        report = json.load(open(out))
+        assert report["smoke"] is True and report["arch"] == "alexnet"
+        assert any("voc_2007_trainval: 16 records" in line
+                   for line in report["conversion"])
+        assert report["train"]["epochs"] == 1
+        assert 0.0 <= report["train"]["map_voc07"] <= 1.0
+        assert report["train"]["images"] == 8
+        # scratch paths are scrubbed from the artifact
+        assert "<tmp>" in report["conversion"][0]

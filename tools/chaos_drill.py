@@ -3,11 +3,11 @@ and assert loss-trajectory continuity across restarts.
 
 Round-5 VERDICT critique: driver-facing tools kept shipping with zero
 committed executions.  This drill is the banked execution for the
-resilience layer — ``RESILIENCE_r01.json`` at the repo root is its
+resilience layer — ``RESILIENCE_r02.json`` at the repo root is its
 committed output (seeded + deterministic: no wall-clock or hostnames in
-the artifact).
+the artifact; ``RESILIENCE_r01.json`` was the pre-anomaly r01 run).
 
-Two parts:
+Three parts:
 
 1. **shard_read** — reads a generated ``.azr`` shard set through the
    retrying reader with injected transient open/read errors plus one
@@ -21,11 +21,22 @@ Two parts:
    snapshot), a stalled step (watchdog), and a plain crash.  Survival =
    the supervisor restarts each time, every resume starts from a
    checkpoint (never step 0), and the final loss beats the initial.
+3. **anomaly** — the numerical ladder (``resilience.anomaly``) under
+   injected numerical faults: a single ``nan_grads`` batch → the step
+   is skipped in-graph (params untouched) and a forensics bundle is
+   written; ``rollback_after`` consecutive bad batches → rollback to
+   the last-known-good tier (params verified bit-identical to the
+   promoted snapshot) + deterministic re-seek; persistent
+   ``corrupt_batch`` scrambling → the rollback budget exhausts and
+   ``TrainingDiverged`` escapes ``run_resilient`` WITHOUT a retry
+   (fatal by taxonomy).  ``tools/replay_batch.py`` then re-materializes
+   the first recorded bad batch byte-identically and classifies the
+   cause.
 
 Usage::
 
-    python tools/chaos_drill.py --smoke            # CI-sized, ~30 s CPU
-    python tools/chaos_drill.py --out RESILIENCE_r01.json
+    python tools/chaos_drill.py --smoke            # CI-sized, ~40 s CPU
+    python tools/chaos_drill.py --out RESILIENCE_r02.json
 """
 
 from __future__ import annotations
@@ -262,11 +273,159 @@ def training_drill(tmpdir: str, rng: random.Random, smoke: bool) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Part 3: numerical-anomaly ladder drill
+# ---------------------------------------------------------------------------
+
+
+def build_anomaly_schedule(rng: random.Random, rollback_after: int) -> list:
+    """Seeded ladder schedule: one isolated ``nan_grads`` batch (skip),
+    one exactly-K burst (first rollback), then a persistent
+    ``corrupt_batch`` window that exhausts the rollback budget."""
+    from analytics_zoo_tpu.resilience.chaos import FaultSpec
+
+    p1 = rng.randint(3, 5)
+    p2 = p1 + rng.randint(6, 9)
+    p3 = p2 + rollback_after + rng.randint(6, 9)
+    return [FaultSpec("nan_grads", p1),
+            FaultSpec("nan_grads", p2, batches=rollback_after),
+            FaultSpec("corrupt_batch", p3, batches=500)]
+
+
+def anomaly_drill(tmpdir: str, rng: random.Random, smoke: bool) -> dict:
+    import numpy as np
+
+    from analytics_zoo_tpu.core.criterion import MSECriterion
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.data.dataset import DataSet
+    from analytics_zoo_tpu.parallel import (
+        SGD,
+        Optimizer,
+        Trigger,
+        run_resilient,
+    )
+    from analytics_zoo_tpu.resilience.anomaly import AnomalyPolicy
+    from analytics_zoo_tpu.resilience.chaos import ChaosMonkey, mutate_batch
+    from analytics_zoo_tpu.resilience.errors import TrainingDiverged
+    from flax import linen as nn
+    import jax.numpy as jnp
+
+    dim, batch, n_batches = 4, 8, 8
+    base_seed = rng.randint(0, 2**31 - 1)
+    data_rng = np.random.RandomState(rng.randint(0, 2**31 - 1))
+    w = data_rng.randn(dim, 1).astype(np.float32)
+    X = data_rng.randn(batch * n_batches, dim).astype(np.float32)
+    Y = (X @ w).astype(np.float32)
+
+    def fresh_pipeline():
+        """A FRESHLY-constructed deterministic loader (PR-2 contract) —
+        both the training run and every forensics replay build one."""
+        return (DataSet.from_arrays(input=X, target=Y)
+                .batch(batch).parallel(0, base_seed=base_seed))
+
+    policy = AnomalyPolicy(rollback_after=3, promote_after=4,
+                           max_rollbacks=2)
+    ckpt_path = os.path.join(tmpdir, "anomaly_ckpt")
+    faults = build_anomaly_schedule(rng, policy.rollback_after)
+    monkey = ChaosMonkey(faults, checkpoint_path=ckpt_path)
+    chaos_data = monkey.dataset(fresh_pipeline())
+    opts, restarts = [], []
+
+    def build():
+        m = Model(nn.Dense(1))
+        m.build(0, jnp.zeros((1, dim), jnp.float32))
+        opt = (Optimizer(m, chaos_data, MSECriterion())
+               .set_optim_method(SGD(0.05))
+               .set_checkpoint(ckpt_path, Trigger.several_iteration(2),
+                               overwrite=False, keep_last=4)
+               .set_anomaly_policy(policy)
+               .set_end_when(Trigger.or_(Trigger.max_epoch(40),
+                                         Trigger.max_wall_time(300))))
+        opts.append(opt)
+        return opt
+
+    diverged = None
+    with monkey:
+        try:
+            run_resilient(build, ckpt_path, max_restarts=4,
+                          on_restart=lambda a, e: restarts.append(
+                              {"attempt": a, "error": type(e).__name__}))
+        except TrainingDiverged as e:
+            diverged = str(e).split("\n")[0].replace(ckpt_path, "<ckpt>")
+
+    sent = opts[-1]._anomaly
+    events = []
+    for e in sent.events:   # scrub scratch paths for a stable artifact
+        e = dict(e)
+        if "path" in e:
+            e["path"] = os.path.basename(e["path"])
+        events.append(e)
+    rollbacks = [e for e in events if e["kind"] == "rollback"]
+    skips = [e for e in events if e["kind"] == "skip"]
+    single_at = faults[0].at_batch
+
+    # -- forensics replay: re-materialize the FIRST recorded bad batch ----
+    import json as _json
+
+    from tools.replay_batch import replay as replay_bundle
+
+    with open(sent.forensics_paths[0]) as f:
+        bundle = _json.load(f)
+    gidx = bundle["epoch"] * n_batches + bundle["batch_in_epoch"]
+    fault0 = next(f for f in faults
+                  if f.at_batch <= gidx < f.at_batch + f.batches)
+    m2 = Model(nn.Dense(1))
+    m2.build(0, jnp.zeros((1, dim), jnp.float32))
+    replay_report = replay_bundle(
+        bundle, fresh_pipeline(), m2, MSECriterion(), optim=SGD(0.05),
+        batch_transform=lambda b, i: mutate_batch(fault0.kind, b,
+                                                  seed=gidx),
+        checkpoint_path=ckpt_path)
+
+    checks = {
+        # single bad batch: skipped in-graph, no rollback before the burst
+        "single_fault_skipped_without_rollback": any(
+            s["consecutive"] == 1 for s in skips) and all(
+            r["iteration"] > single_at for r in rollbacks),
+        "every_bad_step_skipped": sent.stats()["skipped"]
+        == sent.stats()["bad_steps"] and sent.stats()["bad_steps"] > 0,
+        "rollbacks_exhausted_budget":
+            len(rollbacks) == policy.max_rollbacks,
+        "rollback_params_bit_identical_to_snapshot": bool(rollbacks)
+        and all(r["params_match_snapshot"] for r in rollbacks),
+        "rollback_restored_lkg_tier": bool(rollbacks)
+        and all(r["tier"] == "lkg" for r in rollbacks),
+        "forensics_bundles_written": len(sent.forensics_paths) >= 1,
+        "replay_byte_identical": bool(replay_report["byte_identical"]),
+        "replay_classified_data_cause": replay_report["cause"] == "data",
+        "diverged_raised": diverged is not None,
+        "diverged_not_retried": len(opts) == 1 + len(restarts)
+        and not restarts,
+    }
+    return {
+        "policy": {"rollback_after": policy.rollback_after,
+                   "promote_after": policy.promote_after,
+                   "max_rollbacks": policy.max_rollbacks,
+                   "reseek_batches": policy.reseek},
+        "schedule": [{"kind": f.kind, "at_batch": f.at_batch,
+                      "batches": f.batches} for f in faults],
+        "base_seed": base_seed,
+        "sentinel": sent.stats(),
+        "events": events,
+        "faults_fired": monkey.events[:40],
+        "forensics_bundles": [os.path.basename(p)
+                              for p in sent.forensics_paths],
+        "replay": replay_report,
+        "diverged": diverged,
+        "ladder": {"ok": all(checks.values()), "checks": checks},
+    }
+
+
+# ---------------------------------------------------------------------------
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("--out", default="RESILIENCE_r01.json")
+    ap.add_argument("--out", default="RESILIENCE_r02.json")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--smoke", action="store_true",
                     help="CI-sized run (fewer epochs)")
@@ -283,17 +442,21 @@ def main(argv=None) -> int:
         tmpdir = args.tmpdir or td
         shard = shard_read_drill(os.path.join(tmpdir, "shards"), rng)
         training = training_drill(tmpdir, rng, args.smoke)
+        anomaly = anomaly_drill(tmpdir, rng, args.smoke)
 
     kinds = sorted(set(e["kind"] for e in training["faults_fired"])
+                   | set(e["kind"] for e in anomaly["faults_fired"])
                    | ({"shard_read_error"} if shard["survived"] else set()))
-    survived_all = shard["survived"] and training["continuity"]["ok"]
+    survived_all = (shard["survived"] and training["continuity"]["ok"]
+                    and anomaly["ladder"]["ok"])
     report = {
         "drill": "chaos_drill",
-        "revision": "r01",
+        "revision": "r02",
         "seed": args.seed,
         "smoke": bool(args.smoke),
         "shard_read": shard,
         "training": training,
+        "anomaly": anomaly,
         "fault_kinds_survived": kinds,
         "distinct_fault_kinds": len(kinds),
         "verdict": "PASS" if survived_all and len(kinds) >= 3 else "FAIL",
@@ -304,7 +467,12 @@ def main(argv=None) -> int:
     print(f"chaos drill: {report['verdict']} — {len(kinds)} fault kinds "
           f"({', '.join(kinds)}), {training['continuity']['checks']['restarts']}"
           f" restarts, loss {training['loss_first']:.4f} -> "
-          f"{training['loss_final']:.4f}; wrote {args.out}")
+          f"{training['loss_final']:.4f}; anomaly ladder "
+          f"{'OK' if anomaly['ladder']['ok'] else 'FAILED'} "
+          f"({anomaly['sentinel']['skipped']} skipped, "
+          f"{anomaly['sentinel']['rollbacks']} rollbacks, "
+          f"diverged={'yes' if anomaly['diverged'] else 'no'}); "
+          f"wrote {args.out}")
     return 0 if report["verdict"] == "PASS" else 1
 
 

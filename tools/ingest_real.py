@@ -56,7 +56,7 @@ def _convert_devkit(devkit: str, out_prefix: str, sets: str, shards: int):
 
 
 def _evaluate(model_apply, variables, val_pattern, pre, n_classes,
-              class_names, post):
+              class_names, post, cfg):
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -65,10 +65,8 @@ def _evaluate(model_apply, variables, val_pattern, pre, n_classes,
     from analytics_zoo_tpu.pipelines.evaluation import MeanAveragePrecision
     from analytics_zoo_tpu.pipelines.ssd import load_val_set
 
-    from analytics_zoo_tpu.models import build_priors, ssd300_config, \
-        ssd512_config
+    from analytics_zoo_tpu.models import build_priors
 
-    cfg = ssd300_config() if pre.resolution == 300 else ssd512_config()
     priors, variances = build_priors(cfg)
     pr, va = jnp.asarray(priors), jnp.asarray(variances)
 
@@ -88,7 +86,7 @@ def _evaluate(model_apply, variables, val_pattern, pre, n_classes,
     return float(total.result()), n
 
 
-def main() -> int:
+def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="staged real data -> records -> train/serve -> mAP")
     p.add_argument("--devkit", help="extracted VOCdevkit root "
@@ -97,6 +95,10 @@ def main() -> int:
                                         "(e.g. VGG_VOC0712_SSD_300x300)")
     p.add_argument("--smoke", action="store_true",
                    help="synthesize drill fixtures and run both paths")
+    p.add_argument("--arch", default="vgg", choices=("vgg", "alexnet"),
+                   help="vgg = the reference SSD-VGG; alexnet = the light "
+                        "SSD-AlexNet (fast CI fixture runs — no "
+                        "caffemodel path)")
     p.add_argument("--res", type=int, default=300, choices=(300, 512))
     p.add_argument("--epochs", type=int, default=2,
                    help="training epochs for the records->train->mAP path "
@@ -107,7 +109,7 @@ def main() -> int:
     p.add_argument("--test-set", default="voc_2007_test")
     p.add_argument("--num-shards", type=int, default=8)
     p.add_argument("--out", default="REAL_DATA.json")
-    args = p.parse_args()
+    args = p.parse_args(argv)
 
     if not (args.devkit or args.caffemodel or args.smoke):
         p.error("need --devkit and/or --caffemodel, or --smoke")
@@ -117,8 +119,9 @@ def main() -> int:
     import jax.numpy as jnp
 
     from analytics_zoo_tpu.core.module import Model
-    from analytics_zoo_tpu.models import SSDVgg, build_priors, \
-        ssd300_config, ssd512_config
+    from analytics_zoo_tpu.models import (SSDAlexNet, SSDVgg,
+                                          alexnet_ssd_config, build_priors,
+                                          ssd300_config, ssd512_config)
     from analytics_zoo_tpu.ops import (DetectionOutputParam, MultiBoxLoss,
                                        MultiBoxLossParam)
     from analytics_zoo_tpu.parallel import (SGD, Optimizer, Trigger,
@@ -127,7 +130,14 @@ def main() -> int:
                                                  load_train_set)
     from analytics_zoo_tpu.pipelines.voc import VOC_CLASSES
 
-    report = {"backend": jax.default_backend(),
+    if args.arch == "alexnet" and args.caffemodel:
+        p.error("--caffemodel loads reference SSD-VGG weights; "
+                "use --arch vgg")
+    if args.arch == "alexnet" and args.res != 300:
+        p.error("--arch alexnet is fixed at 300 (alexnet_ssd_config "
+                "prior grid); use --arch vgg for 512")
+
+    report = {"backend": jax.default_backend(), "arch": args.arch,
               "resolution": args.res, "classes": len(VOC_CLASSES)}
     tmp_ctx = tempfile.TemporaryDirectory()
     tmp = tmp_ctx.name
@@ -147,7 +157,7 @@ def main() -> int:
         _write_imageset(voc, "trainval", train_ids)
         _write_imageset(voc, "test", test_ids)
         args.devkit = devkit
-        if not args.caffemodel:
+        if not args.caffemodel and args.arch == "vgg":
             from analytics_zoo_tpu.utils.caffe import (CaffeLayer, CaffeNet,
                                                        save_caffemodel)
 
@@ -176,9 +186,14 @@ def main() -> int:
                               args.num_shards)
         report["conversion"] = log.strip().splitlines()[-4:]
 
-    model = Model(SSDVgg(num_classes=len(VOC_CLASSES), resolution=args.res))
+    if args.arch == "alexnet":
+        model = Model(SSDAlexNet(num_classes=len(VOC_CLASSES)))
+        cfg = alexnet_ssd_config()
+    else:
+        model = Model(SSDVgg(num_classes=len(VOC_CLASSES),
+                             resolution=args.res))
+        cfg = ssd300_config() if args.res == 300 else ssd512_config()
     model.build(0, jnp.zeros((1, args.res, args.res, 3), jnp.float32))
-    cfg = ssd300_config() if args.res == 300 else ssd512_config()
     priors, variances = build_priors(cfg)
     test_pattern = (f"{out_prefix}-{args.test_set}-*.azr"
                     if out_prefix else None)
@@ -203,7 +218,7 @@ def main() -> int:
             t0 = time.time()
             m, n = _evaluate(model.module.apply,
                              {"params": new_params}, test_pattern, pre,
-                             len(VOC_CLASSES), VOC_CLASSES, post)
+                             len(VOC_CLASSES), VOC_CLASSES, post, cfg)
             report["caffemodel"]["map_voc07"] = round(m, 4)
             report["caffemodel"]["images"] = n
             report["caffemodel"]["eval_seconds"] = round(time.time() - t0, 1)
@@ -225,16 +240,29 @@ def main() -> int:
         m, n = _evaluate(model.module.apply,
                          {"params": jax.device_get(model.params)},
                          test_pattern, pre, len(VOC_CLASSES), VOC_CLASSES,
-                         post)
+                         post, cfg)
         report["train"] = {"epochs": args.epochs,
                            "map_voc07": round(m, 4), "images": n,
                            "train_seconds": round(wall, 1)}
         print(f"records->train({args.epochs}ep)->mAP: {m:.4f}",
               file=sys.stderr)
 
+    # scrub the scratch dir from the committed artifact (path strings
+    # would otherwise make REAL_DATA.json differ run to run)
+    def scrub(v):
+        if isinstance(v, str):
+            return v.replace(tmp, "<tmp>")
+        if isinstance(v, list):
+            return [scrub(x) for x in v]
+        if isinstance(v, dict):
+            return {k: scrub(x) for k, x in v.items()}
+        return v
+
+    report = scrub(report)
     print(json.dumps(report, indent=2))
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
+        f.write("\n")
     tmp_ctx.cleanup()
     return 0
 
