@@ -20,6 +20,10 @@ from analytics_zoo_tpu.data.dataset import (
     default_collate,
     pad_ragged,
 )
+from analytics_zoo_tpu.data.bucket import (
+    BucketBatcher,
+    padding_efficiency,
+)
 from analytics_zoo_tpu.data.records import (
     RecordWriter,
     SSDByteRecord,

@@ -127,6 +127,25 @@ class DataSet:
             return out.parallel(num_workers, base_seed=base_seed)
         return out
 
+    def bucket_batch(self, batch_size: int, bucket_edges: Sequence[int],
+                     length_key: str = "n_frames", pad_key: str = "input",
+                     drop_remainder: bool = True,
+                     num_workers: int = 0, base_seed: int = 0):
+        """Length-bucketed batching (:class:`~analytics_zoo_tpu.data.
+        bucket.BucketBatcher`): samples land in the smallest fitting
+        padded-length bucket and a batch is emitted each time a bucket
+        fills — a small pinned set of shapes instead of one max-padded
+        shape.  Terminal like :meth:`batch`; ``num_workers > 0`` wraps
+        the result in a deterministic multiprocess ``ParallelLoader``
+        (the batcher itself always runs serially in the parent)."""
+        from analytics_zoo_tpu.data.bucket import BucketBatcher
+        out = self.transform(BucketBatcher(
+            batch_size, bucket_edges, length_key=length_key,
+            pad_key=pad_key, drop_remainder=drop_remainder))
+        if num_workers > 0:
+            return out.parallel(num_workers, base_seed=base_seed)
+        return out
+
     def parallel(self, num_workers: int, base_seed: int = 0, **kw):
         """Wrap in a multiprocess :class:`~analytics_zoo_tpu.data.
         parallel.ParallelLoader` (``num_workers=0`` = the deterministic

@@ -37,15 +37,31 @@ from analytics_zoo_tpu.core.rnn import BiRecurrent, Recurrent, RnnCell
 
 
 class SequenceBN(nn.Module):
-    """BN over (B·T) per feature (reference ``BatchNormalizationDS``)."""
+    """BN over (B·T) per feature (reference ``BatchNormalizationDS``).
+
+    ``mask`` (broadcastable to ``x``, 1/True = valid frame) restricts the
+    TRAIN-mode batch statistics to valid frames — with length-bucketed
+    ragged batches the zero padding would otherwise bias every layer's
+    mean/var toward zero.  Eval mode uses running stats and ignores it.
+    """
 
     momentum: float = 0.9
     epsilon: float = 1e-5
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, mask=None):
         return nn.BatchNorm(use_running_average=not train,
-                            momentum=self.momentum, epsilon=self.epsilon)(x)
+                            momentum=self.momentum,
+                            epsilon=self.epsilon)(x, mask=mask)
+
+
+def ds2_valid_out_frames(n_frames):
+    """Valid OUTPUT frames after the stride-2 SAME conv front-end for a
+    row with ``n_frames`` valid inputs: ``ceil(n/2)``.  Single source of
+    truth shared by the model's BN/RNN masks and
+    ``pipelines.deepspeech2.ds2_ctc_criterion``'s logit mask — if the
+    conv front-end ever changes, both masks move together."""
+    return (n_frames + 1) // 2
 
 
 class DeepSpeech2(nn.Module):
@@ -65,18 +81,34 @@ class DeepSpeech2(nn.Module):
     # beyond the conv's 5-frame lookahead); param names differ from the
     # bidirectional model (rnn{i} vs birnn{i})
     bidirectional: bool = True
+    # recurrent fast path (core.rnn): hoisted input projections + a
+    # time-blocked scan unrolling rnn_block steps per iteration.  False
+    # keeps the per-step nn.scan body (the bench A/B baseline); the
+    # parameter tree is identical either way.
+    rnn_hoist: bool = True
+    rnn_block: int = 16
 
     @nn.compact
-    def __call__(self, x, train: bool = False, carry=None,
+    def __call__(self, x, n_frames=None, train: bool = False, carry=None,
                  return_carry: bool = False):
         """``carry``/``return_carry`` enable exact streaming inference
         (unidirectional only): ``carry = {"h": (per-layer hidden,)}``, the
         input must be pre-extended with boundary context frames by the
         caller (``pipelines.deepspeech2.StreamingDS2`` owns that math) and
-        the conv runs VALID instead of SAME."""
+        the conv runs VALID instead of SAME.
+
+        ``n_frames`` (per-row valid input frame counts, int32 (B,)) makes
+        zero-padding correctness-inert on length-bucketed ragged batches:
+        BN statistics are computed over valid frames only, each RNN
+        layer's carry freezes past the row's length, and the backward
+        pass reverses only the valid prefix (the padded-reverse fix in
+        ``core.rnn``).  Output frames past ``ceil(n_frames/2)`` carry no
+        signal — mask them out of the CTC loss via ``logit_mask``."""
         streaming = carry is not None or return_carry
         if streaming and self.bidirectional:
             raise ValueError("streaming requires bidirectional=False")
+        if n_frames is not None and not self.rnn_hoist:
+            raise ValueError("n_frames masking requires rnn_hoist=True")
         B, T, F = x.shape
         h = x[..., None]                                  # (B, T, F, 1)
         # conv front-end: stride 2 in time halves T (DS2 conv1 11x13-ish
@@ -84,8 +116,16 @@ class DeepSpeech2(nn.Module):
         pad = ((0, 0), (0, 0)) if streaming else ((5, 5), (0, 0))
         h = nn.Conv(self.conv_channels, (11, self.n_mels), strides=(2, 1),
                     padding=pad, name="conv1")(h)
-        h = SequenceBN(name="bn_conv1")(h.reshape(B, h.shape[1], -1),
-                                        train=train)
+        h = h.reshape(B, h.shape[1], -1)
+        out_n = bn_mask = None
+        if n_frames is not None:
+            # stride-2 SAME conv: a row with n valid inputs yields
+            # ceil(n/2) valid outputs (identical to its unpadded forward
+            # because the right-SAME pad is zero either way)
+            out_n = ds2_valid_out_frames(jnp.asarray(n_frames, jnp.int32))
+            bn_mask = (jnp.arange(h.shape[1], dtype=jnp.int32)[None, :]
+                       < out_n[:, None])[..., None]       # (B, T', 1)
+        h = SequenceBN(name="bn_conv1")(h, train=train, mask=bn_mask)
         h = jnp.clip(h, 0.0, 20.0)                        # clipped ReLU
         new_h = []
         for i in range(self.n_rnn_layers):
@@ -93,17 +133,22 @@ class DeepSpeech2(nn.Module):
             # ``RNN.scala:28``): one MXU matmul over the whole sequence,
             # then the scan applies only the h2h recurrence
             h = nn.Dense(self.hidden, name=f"proj{i}")(h)
-            h = SequenceBN(name=f"bn_rnn{i}")(h, train=train)
+            h = SequenceBN(name=f"bn_rnn{i}")(h, train=train, mask=bn_mask)
             cell = RnnCell(hidden_size=self.hidden, identity_input=True,
                            activation="clipped_relu")
             if self.bidirectional:
-                h = BiRecurrent(cell=cell, merge="sum", name=f"birnn{i}")(h)
+                h = BiRecurrent(cell=cell, merge="sum",
+                                hoist=self.rnn_hoist,
+                                block_size=self.rnn_block,
+                                name=f"birnn{i}")(h, n_frames=out_n)
             else:
                 h0 = carry["h"][i] if carry is not None else None
-                h, hN = Recurrent(cell=cell, name=f"rnn{i}")(
-                    h, carry0=h0, return_carry=True)
+                h, hN = Recurrent(cell=cell, hoist=self.rnn_hoist,
+                                  block_size=self.rnn_block,
+                                  name=f"rnn{i}")(
+                    h, carry0=h0, return_carry=True, n_frames=out_n)
                 new_h.append(hN)
-        h = SequenceBN(name="bn_out")(h, train=train)
+        h = SequenceBN(name="bn_out")(h, train=train, mask=bn_mask)
         logits = nn.Dense(self.n_alphabet, name="fc_out")(h)
         out = jax.nn.log_softmax(logits, axis=-1)
         if return_carry:
@@ -270,6 +315,12 @@ def make_sequence_parallel_forward_fn(model: "DeepSpeech2", mesh,
     rngs) → (log_probs, new_model_state)``."""
 
     def forward_fn(variables, inputs, train=False, rngs=None):
+        if isinstance(inputs, (tuple, list)):
+            raise ValueError(
+                "sequence-parallel DS2 has no n_frames masking and does "
+                "not support length-bucketed (features, n_frames) "
+                "batches — train with bucket_edges=None (pad to a fixed "
+                "utt_length) when sequence_parallel=True")
         out = sequence_parallel_forward(variables, inputs, mesh,
                                         axis_name=axis_name,
                                         batch_axis=batch_axis,

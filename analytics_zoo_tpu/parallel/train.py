@@ -126,8 +126,14 @@ def make_train_step(
     forward_fn: Optional[Callable] = None,
     health_check: bool = False,
     skip_unhealthy: bool = False,
+    metric_fn: Optional[Callable] = None,
 ):
     """Build the jitted train step.
+
+    ``metric_fn`` (optional): ``metric_fn(batch) → {name: scalar}``,
+    fused into the compiled step and merged into the returned metrics —
+    e.g. the length-bucketed DS2 path reports ``padding_efficiency``
+    (valid / padded frames) per step from the batch's ``n_frames``.
 
     ``device_transform`` (optional) is fused INTO the compiled step: the
     batch passes through it on-device before the loss (used for the
@@ -268,6 +274,8 @@ def make_train_step(
         updates, new_opt_state = optim.tx.update(grads, opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss, "lr": lr}
+        if metric_fn is not None:
+            metrics.update(metric_fn(batch))
         # merge: mutable apply only returns the batch_stats collection; any
         # other collection in model_state must survive untouched
         merged_model_state = {**state.model_state, **new_model_state}
@@ -440,7 +448,7 @@ class Optimizer:
                  compute_dtype=None, device_transform=None,
                  param_rules=None, prefetch: int = 0,
                  grad_accum: int = 1, forward_fn=None,
-                 batch_overrides=None):
+                 batch_overrides=None, metric_fn=None):
         self.model = model
         self.dataset = dataset
         self.criterion = criterion
@@ -473,6 +481,9 @@ class Optimizer:
         # custom forward (make_train_step forward_fn hook), e.g. the
         # sequence-parallel DS2 program
         self.forward_fn = forward_fn
+        # in-graph extra step metrics (make_train_step metric_fn hook),
+        # e.g. the bucketed DS2 padding_efficiency report
+        self.metric_fn = metric_fn
         # per-key PartitionSpec overrides for shard_batch, e.g.
         # {"input": tensor.spatial_input_spec()} for spatial TP
         self.batch_overrides = batch_overrides
@@ -618,6 +629,7 @@ class Optimizer:
             forward_fn=self.forward_fn,
             health_check=anomaly_on,
             skip_unhealthy=anomaly_on and self.anomaly_policy.skip,
+            metric_fn=self.metric_fn,
         )
         if anomaly_on:
             from analytics_zoo_tpu.resilience.anomaly import (

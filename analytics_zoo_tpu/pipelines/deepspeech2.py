@@ -279,13 +279,55 @@ class DeepSpeech2Pipeline:
 
 def make_ds2_model(hidden: int = 1024, n_rnn_layers: int = 3,
                    n_mels: int = 13, utt_length: int = 300,
-                   seed: int = 0, bidirectional: bool = True) -> Model:
+                   seed: int = 0, bidirectional: bool = True,
+                   rnn_hoist: bool = True, rnn_block: int = 16) -> Model:
     """``bidirectional=False`` builds the forward-only (streamable)
-    variant consumed by :class:`StreamingDS2`."""
+    variant consumed by :class:`StreamingDS2`.  ``rnn_hoist=False``
+    selects the legacy per-step scan body (the bench A/B baseline);
+    the parameter tree is identical either way, so checkpoints move
+    freely between the two."""
     model = Model(DeepSpeech2(hidden=hidden, n_rnn_layers=n_rnn_layers,
-                              n_mels=n_mels, bidirectional=bidirectional))
+                              n_mels=n_mels, bidirectional=bidirectional,
+                              rnn_hoist=rnn_hoist, rnn_block=rnn_block))
     model.build(seed, jnp.zeros((1, utt_length, n_mels)))
     return model
+
+
+def ds2_ctc_criterion(blank_id: int = 0):
+    """CTC criterion closure for DS2 batches.  Length-bucketed batches
+    carry per-row ``n_frames``; the valid OUTPUT frame count after the
+    stride-2 conv is ``ceil(n/2)``, and frames past it are masked out of
+    the loss (they carry no signal — the model zeroes them when fed
+    ``n_frames``)."""
+    from analytics_zoo_tpu.core.criterion import CTCCriterion
+
+    ctc = CTCCriterion(blank_id=blank_id)
+
+    def criterion(log_probs, batch):
+        from analytics_zoo_tpu.models.deepspeech2 import ds2_valid_out_frames
+
+        logit_mask = None
+        if isinstance(batch, dict) and "n_frames" in batch:
+            out_n = ds2_valid_out_frames(batch["n_frames"].astype(jnp.int32))
+            T = log_probs.shape[1]
+            logit_mask = (jnp.arange(T, dtype=jnp.int32)[None, :]
+                          < out_n[:, None]).astype(jnp.float32)
+        return ctc(log_probs, batch["labels"], logit_mask=logit_mask,
+                   label_mask=batch.get("label_mask"))
+
+    return criterion
+
+
+def ds2_padding_metric(batch):
+    """``make_train_step metric_fn``: valid/padded input-frame ratio of a
+    length-bucketed batch (1.0 for unbucketed fixed-shape batches)."""
+    if not (isinstance(batch, dict) and "n_frames" in batch):
+        return {}
+    x = batch["input"][0] if isinstance(batch["input"], tuple) \
+        else batch["input"]
+    total = x.shape[0] * x.shape[1]
+    return {"padding_efficiency":
+            jnp.sum(batch["n_frames"].astype(jnp.float32)) / total}
 
 
 def train_ds2(model: Model, dataset, epochs: int = 10, lr: float = 3e-4,
@@ -294,6 +336,10 @@ def train_ds2(model: Model, dataset, epochs: int = 10, lr: float = 3e-4,
     """CTC training for DS2 — capability the reference lacks (its DS2 is
     inference-only; SURVEY.md §2.3).  ``dataset`` yields batches
     ``{"input": (B,T,n_mels), "labels": (B,L) int32, "label_mask": (B,L)}``.
+    Length-bucketed batches (``load_asr_train_set(bucket_edges=...)``)
+    instead carry ``"input": ((B,T_bucket,n_mels), n_frames)`` — the model
+    length-masks padding, the CTC loss masks invalid output frames, and
+    step metrics gain ``padding_efficiency``.
     ``param_rules`` enables tensor-parallel weight sharding
     (``parallel.tensor.default_tp_rules``) on a data×model mesh.
 
@@ -306,15 +352,10 @@ def train_ds2(model: Model, dataset, epochs: int = 10, lr: float = 3e-4,
     loss itself consumes the (tiny, n_alphabet-wide) log-probs gathered
     back over T.
     """
-    from analytics_zoo_tpu.core.criterion import CTCCriterion
     from analytics_zoo_tpu.parallel import Adam, Optimizer, Trigger, create_mesh
 
     mesh = mesh or create_mesh()
-    ctc = CTCCriterion(blank_id=0)
-
-    def criterion(log_probs, batch):
-        return ctc(log_probs, batch["labels"],
-                   label_mask=batch.get("label_mask"))
+    criterion = ds2_ctc_criterion(blank_id=0)
 
     forward_fn = None
     if sequence_parallel:
@@ -328,7 +369,8 @@ def train_ds2(model: Model, dataset, epochs: int = 10, lr: float = 3e-4,
             batch_axis="data" if "data" in mesh.axis_names else None)
 
     opt = (Optimizer(model, dataset, criterion, mesh=mesh,
-                     param_rules=param_rules, forward_fn=forward_fn)
+                     param_rules=param_rules, forward_fn=forward_fn,
+                     metric_fn=ds2_padding_metric)
            .set_optim_method(Adam(lr))
            .set_end_when(Trigger.max_epoch(epochs)))
     if checkpoint_path:
@@ -524,7 +566,10 @@ def load_asr_train_set(samples: np.ndarray, labels: np.ndarray,
                        batch_size: int = 8,
                        utt_length: Optional[int] = None,
                        n_mels: int = 13, shuffle: bool = True,
-                       seed: int = 0, worker_processes: int = 0):
+                       seed: int = 0, worker_processes: int = 0,
+                       sample_lengths: Optional[np.ndarray] = None,
+                       bucket_edges: Optional[Sequence[int]] = None,
+                       param=None):
     """DataSet of featurized CTC train batches from raw waveforms.
 
     The host featurize (frame → rFFT → mel, ``transform.audio.
@@ -541,24 +586,93 @@ def load_asr_train_set(samples: np.ndarray, labels: np.ndarray,
     (0-padded); ``label_lengths``: (N,) true lengths (defaults to
     counting nonzero labels).  Batches: ``{"input", "labels",
     "label_mask"}`` ready for ``CTCCriterion``.
+
+    **Length-bucketed mode** (``bucket_edges``, frame counts): ragged
+    waveforms (``sample_lengths`` giving true per-row sample counts)
+    are featurized at their TRUE length and batched into the smallest
+    fitting padded bucket (``data.bucket.BucketBatcher`` — compile once
+    per bucket, deterministic for any worker count, replayable from the
+    PR-2 ``(base_seed, epoch, index)`` coordinates).  Batches then carry
+    ``"input": (features, n_frames)`` so the model length-masks padding,
+    plus top-level ``n_frames`` for the CTC logit mask and the
+    ``padding_efficiency`` step metric.  ``param``
+    (:class:`~analytics_zoo_tpu.pipelines.ssd.PreProcessParam`) supplies
+    ``batch_size`` / ``worker_processes`` / ``loader_seed`` /
+    ``bucket_edges`` in one object for pipeline-level wiring.
     """
     from analytics_zoo_tpu.data import DataSet, FnTransformer
+
+    if param is not None:
+        batch_size = param.batch_size
+        worker_processes = param.worker_processes
+        seed = param.loader_seed
+        if getattr(param, "bucket_edges", None):
+            bucket_edges = param.bucket_edges
 
     samples = np.asarray(samples, np.float32)
     labels = np.asarray(labels, np.int32)
     if label_lengths is None:
         label_lengths = (labels != 0).sum(axis=1).astype(np.int32)
+    if sample_lengths is None:
+        sample_lengths = np.full((len(samples),), samples.shape[1], np.int64)
+    sample_lengths = np.asarray(sample_lengths, np.int64)
     L = labels.shape[1]
 
-    def feat(s):
-        x = featurize(s["samples"], utt_length=utt_length, n_mels=n_mels)
-        mask = (np.arange(L) < s["n_label"]).astype(np.float32)
-        return {"input": x.astype(np.float32), "labels": s["labels"],
-                "label_mask": mask}
+    base = DataSet.from_arrays(samples=samples, labels=labels,
+                               n_label=label_lengths,
+                               n_sample=sample_lengths,
+                               shuffle=shuffle, seed=seed)
 
-    return (DataSet.from_arrays(samples=samples, labels=labels,
-                                n_label=label_lengths,
-                                shuffle=shuffle, seed=seed)
-            .transform(FnTransformer(feat))
-            .batch(batch_size, num_workers=worker_processes,
-                   base_seed=seed))
+    if bucket_edges is None:
+        def feat(s):
+            x = featurize(s["samples"], utt_length=utt_length,
+                          n_mels=n_mels)
+            mask = (np.arange(L) < s["n_label"]).astype(np.float32)
+            return {"input": x.astype(np.float32), "labels": s["labels"],
+                    "label_mask": mask}
+
+        return (base.transform(FnTransformer(feat))
+                .batch(batch_size, num_workers=worker_processes,
+                       base_seed=seed))
+
+    # fail fast on under-sized edges: BucketBatcher would silently
+    # truncate input FRAMES while the labels stay full-length, which can
+    # leave CTC with no feasible alignment (inf loss poisoning the batch)
+    from analytics_zoo_tpu.transform.audio.featurize import (
+        WINDOW_SIZE, WINDOW_STRIDE)
+    max_frames = (int(sample_lengths.max()) - WINDOW_SIZE) \
+        // WINDOW_STRIDE + 1
+    if max_frames > max(bucket_edges):
+        raise ValueError(
+            f"bucket_edges[-1]={max(bucket_edges)} < the longest "
+            f"utterance's {max_frames} frames — add a covering last "
+            "edge (or pre-segment the audio); truncating frames but "
+            "not labels can make the CTC loss infeasible")
+
+    def feat_ragged(s):
+        n_samp = int(s["n_sample"])
+        x = featurize(s["samples"][:n_samp], utt_length=None,
+                      n_mels=n_mels)
+        mask = (np.arange(L) < s["n_label"]).astype(np.float32)
+        return {"input": x.astype(np.float32),
+                "n_frames": np.int32(x.shape[0]),
+                "labels": s["labels"], "label_mask": mask}
+
+    def pack(batch):
+        # model contract: inputs as (features, n_frames) so the forward
+        # receives the lengths positionally; n_frames stays top-level
+        # for the CTC logit mask + padding_efficiency metric
+        return {"input": (batch["input"], batch["n_frames"]),
+                "n_frames": batch["n_frames"],
+                "labels": batch["labels"],
+                "label_mask": batch["label_mask"]}
+
+    from analytics_zoo_tpu.data.bucket import BucketBatcher
+    ds = (base.transform(FnTransformer(feat_ragged))
+          .transform(BucketBatcher(batch_size, bucket_edges,
+                                   length_key="n_frames",
+                                   pad_key="input"))
+          .transform(FnTransformer(pack)))
+    if worker_processes > 0:
+        return ds.parallel(worker_processes, base_seed=seed)
+    return ds

@@ -112,6 +112,11 @@ class PreProcessParam:
     # transfer (DeviceAugParam.pack): wins when per-transfer latency,
     # not bandwidth, bounds the input link
     pack_staging: bool = False
+    # length-bucketed batching edges (data.bucket.BucketBatcher) for
+    # variable-length pipelines — consumed by the DS2 ASR loader
+    # (pipelines.deepspeech2.load_asr_train_set(param=...)); the fixed-
+    # resolution SSD/FRCNN image chains have no length axis and ignore it
+    bucket_edges: Optional[Sequence[int]] = None
 
     def __post_init__(self):
         # fail fast on the serving path too — a typo'd wire_format would

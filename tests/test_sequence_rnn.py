@@ -183,8 +183,12 @@ class TestSequenceParallelTraining:
                 sorted(jax.tree_util.tree_leaves_with_path(g_sp),
                        key=lambda t: str(t[0]))):
             assert str(pr) == str(ps)
+            # rtol headroom: the reference side now defaults to the
+            # hoisted/blocked scan (core.rnn), whose f32 reduction order
+            # differs from the hand-written seq-parallel scan by a few
+            # ulps per step
             np.testing.assert_allclose(
-                np.asarray(s), np.asarray(r), rtol=5e-4, atol=1e-5,
+                np.asarray(s), np.asarray(r), rtol=5e-3, atol=5e-5,
                 err_msg=f"grad mismatch at {pr}")
         for name, tree in stats_sp.items():
             for key in ("mean", "var"):

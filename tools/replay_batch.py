@@ -39,6 +39,13 @@ The provider is an importable ``module:function`` returning a dict::
 ``batch_transform`` re-applies any transformation the training loop did
 AFTER the loader (chaos drills re-apply the recorded injected
 corruption here, so the replayed bytes still match the recorded hash).
+
+Length-bucketed streams (``data.bucket.BucketBatcher``, e.g.
+``load_asr_train_set(bucket_edges=...)``) replay through the same hook
+unchanged: the batcher is a trailing parent-process stage, so a
+recorded bucketed batch re-materializes byte-identically from its
+``(base_seed, epoch, index)`` coordinates for any worker count
+(pinned by ``tests/test_bucket.py``).
 """
 
 from __future__ import annotations
