@@ -64,10 +64,7 @@ class BucketBatcher(Transformer):
         self.truncated = 0
 
     def _edge_for(self, n: int) -> int:
-        for e in self.bucket_edges:
-            if n <= e:
-                return e
-        return self.bucket_edges[-1]
+        return edge_for(n, self.bucket_edges)
 
     def _make_batch(self, edge: int, samples: List[Dict[str, Any]]):
         rows = []
@@ -103,6 +100,18 @@ class BucketBatcher(Transformer):
             for edge in self.bucket_edges:
                 if buckets[edge]:
                     yield self._make_batch(edge, buckets[edge])
+
+
+def edge_for(n: int, edges: Sequence[int]) -> int:
+    """Smallest bucket edge that fits length ``n`` (the last edge when
+    none does — the caller truncates).  THE bucket-assignment rule:
+    shared by the train-side :class:`BucketBatcher` and the serving
+    batcher (``serving.batcher.DeadlineBatcher``), so online batches
+    land on exactly the padded geometries training already compiled."""
+    for e in edges:
+        if n <= e:
+            return e
+    return edges[-1]
 
 
 def padding_efficiency(n_frames, padded_len: int) -> float:

@@ -16,7 +16,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import time
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 import jax.numpy as jnp
@@ -676,3 +676,71 @@ def load_asr_train_set(samples: np.ndarray, labels: np.ndarray,
     if worker_processes > 0:
         return ds.parallel(worker_processes, base_seed=seed)
     return ds
+
+
+def ds2_serving_tiers(model: Model, param: Optional[DS2Param] = None,
+                      degraded_beam: Optional[int] = None) -> List:
+    """Degradation-ladder rungs for the online serving runtime
+    (``serving.ServingRuntime``): prefix-beam width is DS2's analog of
+    the SSD ladder's NMS top-K — the decode-side work that can be cut
+    under overload with a bounded, explicit quality loss.
+
+    Requests carry ONE featurized utterance
+    (``{"input": (n_frames, n_mels) float32}``, ``length=n_frames``);
+    the serving batcher pads the time axis to a configured bucket edge
+    (``bucket_edges`` should match the training ``BucketBatcher`` edges
+    so serving reuses compiled geometries) and hands the forward
+    ``{"input": (B, edge, n_mels), "n_frames": (B,)}``.  Each tier
+    decodes only ``ds2_valid_out_frames(n)`` output frames per row —
+    padding never reaches the decoder.
+
+    Tiers (cheapest last): full prefix-beam (``param.beam_width``),
+    reduced beam (``degraded_beam``, default ``max(4, width // 4)``),
+    greedy best-path.  With ``param.decoder == "greedy"`` there is no
+    decode quality to shed, so the ladder is the single greedy tier.
+    """
+    from analytics_zoo_tpu.models.deepspeech2 import ds2_valid_out_frames
+    from analytics_zoo_tpu.serving.ladder import ServingTier
+    from analytics_zoo_tpu.transform.audio import beam_search_decode
+
+    param = param or DS2Param()
+    eval_step = make_eval_step(model.module)
+
+    def forward_with(decode: Callable[[np.ndarray], str]):
+        def forward(batch: Dict) -> List[str]:
+            feats = batch["input"]
+            n_frames = batch.get("n_frames")
+            log_probs = np.asarray(eval_step(model.variables,
+                                             jnp.asarray(feats)))
+            texts: List[str] = []
+            for i in range(feats.shape[0]):
+                n = (int(n_frames[i]) if n_frames is not None
+                     else feats.shape[1])
+                if n <= 0:          # batch-axis padding row
+                    texts.append("")
+                    continue
+                texts.append(decode(log_probs[i, :ds2_valid_out_frames(n)]))
+            return texts
+        return forward
+
+    if param.decoder == "greedy":
+        return [ServingTier("greedy", forward_with(best_path_decode),
+                            speed=1.0, quality_note="best-path decode")]
+    width = param.beam_width
+    low = degraded_beam if degraded_beam is not None else max(4, width // 4)
+    return [
+        ServingTier(f"beam{width}",
+                    forward_with(lambda lp: beam_search_decode(
+                        lp, beam_width=width)),
+                    speed=1.0,
+                    quality_note=f"prefix beam search, width {width}"),
+        ServingTier(f"beam{low}",
+                    forward_with(lambda lp: beam_search_decode(
+                        lp, beam_width=low)),
+                    speed=0.85,
+                    quality_note=f"reduced beam width {low} (bounded "
+                                 "WER cost under overload)"),
+        ServingTier("greedy", forward_with(best_path_decode), speed=0.7,
+                    quality_note="best-path decode (no beam) — the "
+                                 "cheapest rung"),
+    ]

@@ -64,8 +64,22 @@ logger = logging.getLogger("analytics_zoo_tpu")
 #: kinds that MUTATE the yielded batch instead of raising/killing
 NUMERICAL_KINDS = ("nan_grads", "inf_loss", "corrupt_batch")
 
+#: kinds the SERVING runtime consumes (``serving.runtime`` /
+#: ``tools/serve_drill.py``) via :meth:`ChaosMonkey.serving_active` —
+#: they never fire from a wrapped training dataset:
+#:
+#: ``slow_forward``   injected latency on ONE replica's forward
+#:                    (``detail={"replica": r, "delay_s": d}``) — drives
+#:                    the StallWatchdog-wedged → fence → failover path
+#: ``replica_crash``  the targeted replica's forward raises mid-batch
+#:                    (``detail={"replica": r}``)
+#: ``burst_load``     arrival-rate spike: the drill's workload generator
+#:                    multiplies its arrival rate by
+#:                    ``detail={"rate_x": k}`` inside the window
+SERVING_KINDS = ("slow_forward", "replica_crash", "burst_load")
+
 KINDS = ("crash", "xla_transient", "sigterm", "mid_save_kill",
-         "corrupt_latest", "stall") + NUMERICAL_KINDS
+         "corrupt_latest", "stall") + NUMERICAL_KINDS + SERVING_KINDS
 
 
 def _poison_leaf(batch: Dict[str, Any], key: str) -> np.ndarray:
@@ -163,6 +177,9 @@ class FaultSpec:
     kind: str
     at_batch: int
     batches: int = 1
+    #: kind-specific knobs (serving kinds: target replica, delay, rate
+    #: multiplier).  Plain data so drill schedules stay seedable.
+    detail: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -170,9 +187,11 @@ class FaultSpec:
                              f"one of {KINDS}")
         if self.batches < 1:
             raise ValueError("batches must be >= 1")
-        if self.batches > 1 and self.kind not in NUMERICAL_KINDS:
-            raise ValueError(f"batches>1 only applies to numerical kinds "
-                             f"{NUMERICAL_KINDS}, not {self.kind!r}")
+        if self.batches > 1 and self.kind not in (NUMERICAL_KINDS
+                                                  + SERVING_KINDS):
+            raise ValueError(f"batches>1 only applies to windowed kinds "
+                             f"{NUMERICAL_KINDS + SERVING_KINDS}, "
+                             f"not {self.kind!r}")
 
 
 class ChaosMonkey:
@@ -206,7 +225,8 @@ class ChaosMonkey:
     def _due(self) -> List[int]:
         return [i for i, f in enumerate(self.faults)
                 if not self._fired[i] and f.at_batch <= self.consumed
-                and f.kind not in NUMERICAL_KINDS]
+                and f.kind not in NUMERICAL_KINDS
+                and f.kind not in SERVING_KINDS]
 
     def on_batch(self, batch=None):
         """Fire every due fault (called by the wrapper before each yield)
@@ -296,6 +316,37 @@ class ChaosMonkey:
             # nothing on disk yet — re-arm one batch later
             self._fired[i] = False
             self.faults[i] = FaultSpec(f.kind, f.at_batch + 1)
+
+    # -- serving hooks -----------------------------------------------------
+    def serving_active(self, kind: str, index: int,
+                       consume: bool = True) -> Optional[FaultSpec]:
+        """Window query for the SERVING fault kinds: return the spec of
+        ``kind`` whose ``[at_batch, at_batch + batches)`` window covers
+        ``index``, else ``None``.  Serving drills drive their OWN
+        counter (dispatch index for ``slow_forward``/``replica_crash``,
+        request index for ``burst_load``) — independent of the training
+        batch counter the dataset wrapper advances.
+
+        ``consume=True`` marks the spec fired once ``index`` reaches the
+        window's last slot (so a one-shot ``replica_crash`` fires on
+        exactly one dispatch) and records an event; ``consume=False`` is
+        a pure peek (the workload generator probes ``burst_load`` before
+        time reaches the window)."""
+        if kind not in SERVING_KINDS:
+            raise ValueError(f"not a serving fault kind: {kind!r}; "
+                             f"one of {SERVING_KINDS}")
+        for i, f in enumerate(self.faults):
+            if f.kind != kind or self._fired[i]:
+                continue
+            if not (f.at_batch <= index < f.at_batch + f.batches):
+                continue
+            if consume:
+                self.events.append({"kind": kind, "at_index": int(index),
+                                    **f.detail})
+                if index >= f.at_batch + f.batches - 1:
+                    self._fired[i] = True
+            return f
+        return None
 
     def disarm(self) -> None:
         """Clear a still-armed ``mid_save_kill`` hook.  The hook is a

@@ -85,6 +85,39 @@ class TrainingDiverged(RuntimeError):
     after a non-finite loss streak."""
 
 
+class ServerOverloaded(RuntimeError):
+    """The serving admission queue is full — the request was SHED at
+    submit time, before consuming any device time (``serving.request.
+    AdmissionQueue``).  Retryable WITH BACKOFF: the queue being bounded
+    is the load-shedding contract, so an immediate blind retry from
+    every rejected client would just re-create the overload; clients
+    should back off (exponentially) or hedge to another serving cell."""
+
+
+class RequestTimeout(RuntimeError):
+    """A serving request's deadline passed while it was still queued, so
+    it was shed before device dispatch (a late answer costs the same
+    device time as a useful one).  Retryable: the client may resubmit
+    with a fresh deadline — by then the burst that starved this request
+    has usually drained (or the degradation ladder has stepped down)."""
+
+
+class ReplicaWedged(RuntimeError):
+    """A serving replica's forward wedged past its StallWatchdog
+    deadline or crashed mid-batch.  Dual semantics by design:
+
+    - for the REPLICA this is fatal — the runtime fences it (no further
+      dispatches) and restarts it in the background;
+    - for the REQUESTS of the in-flight batch it is retryable — the
+      runtime re-dispatches that batch to a healthy replica exactly
+      once, and only if THAT dispatch also fails do the requests fail
+      with this error (at which point the client may retry elsewhere).
+
+    Classified retryable in the taxonomy because the error object only
+    ever escapes to request/supervisor scope — replica fencing is
+    handled internally by ``serving.replica.ReplicaPool``."""
+
+
 #: Explicit classification registries.  EVERY exception class defined in
 #: this module must appear in exactly one of the two tuples below — the
 #: taxonomy completeness test (tests/test_anomaly.py) enforces it, so a
@@ -95,6 +128,9 @@ _RETRYABLE_CLASSES: Tuple[Type[BaseException], ...] = (
     StallError,
     PrefetchWorkerDied,
     InjectedFault,
+    ServerOverloaded,
+    RequestTimeout,
+    ReplicaWedged,
 )
 
 #: Fatal: restarting cannot fix these (no intact snapshot left; a shard

@@ -59,7 +59,8 @@ class StallWatchdog:
 
     def __init__(self, timeout_s: float, poll_s: Optional[float] = None,
                  name: str = "train",
-                 on_stall: Optional[Callable[["StallWatchdog"], None]] = None):
+                 on_stall: Optional[Callable[["StallWatchdog"], None]] = None,
+                 clock: Optional[Callable[[], float]] = None):
         if timeout_s <= 0:
             raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
         self.timeout_s = float(timeout_s)
@@ -67,7 +68,12 @@ class StallWatchdog:
                           else min(timeout_s / 4.0, 1.0))
         self.name = name
         self.on_stall = on_stall
-        self._last = time.monotonic()
+        # injectable time source: the serving runtime supervises replica
+        # forwards in PULL mode (beat → check) on a virtual clock so the
+        # wedged-replica path is deterministic in tests and the drill;
+        # the threaded monitor path keeps real time by default
+        self._clock = clock if clock is not None else time.monotonic
+        self._last = self._clock()
         self._stalled = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -78,7 +84,7 @@ class StallWatchdog:
             return self
         self._stop.clear()
         self._stalled = False
-        self._last = time.monotonic()
+        self._last = self._clock()
         self._thread = threading.Thread(
             target=self._monitor, name=f"stall-watchdog-{self.name}",
             daemon=True)
@@ -100,7 +106,15 @@ class StallWatchdog:
     # -- heartbeat ---------------------------------------------------------
     def beat(self) -> None:
         """Record one unit of progress (resets the deadline)."""
-        self._last = time.monotonic()
+        self._last = self._clock()
+
+    def reset(self) -> None:
+        """Clear a latched stall verdict and restart the deadline —
+        for supervised units that RECOVER in place (a serving replica
+        coming back from its background restart).  The push-mode
+        monitor thread latches via ``start()`` instead."""
+        self._stalled = False
+        self._last = self._clock()
 
     @property
     def stalled(self) -> bool:
@@ -109,7 +123,7 @@ class StallWatchdog:
     @property
     def age_s(self) -> float:
         """Seconds since the last heartbeat."""
-        return time.monotonic() - self._last
+        return self._clock() - self._last
 
     def check(self) -> None:
         """Pull-style: raise :class:`StallError` if the deadline passed
@@ -123,7 +137,7 @@ class StallWatchdog:
     # -- monitor -----------------------------------------------------------
     def _monitor(self) -> None:
         while not self._stop.wait(self.poll_s):
-            age = time.monotonic() - self._last
+            age = self._clock() - self._last
             if age > self.timeout_s:
                 self._stalled = True
                 logger.error(

@@ -1,0 +1,45 @@
+"""Online serving resilience runtime.
+
+The training side got its resilience ladder in PRs 1 and 3 (chaos
+drills, anomaly rollback); this package is the serving twin — the
+ROADMAP's "heavy traffic from millions of users" story.  The reference's
+only serving mechanism is a Spark broadcast predictor
+(``common/Predictor.scala``); here the existing offline predictors
+(``SSDPredictor``, ``FrcnnPredictor``, ``DeepSpeech2Pipeline``,
+``StreamingDS2``) are wrapped behind a request-level API with explicit
+overload behavior, in the spirit of Clipper's adaptive batching /
+load-shedding frontier (Crankshaw et al., NSDI'17) and Clockwork's
+predictable-latency discipline (Gujarati et al., OSDI'20):
+
+- :mod:`clock` — injected time (:class:`VirtualClock` for deterministic
+  tests/drills, :class:`MonotonicClock` for production);
+- :mod:`request` — :class:`Request`, bounded EDF :class:`AdmissionQueue`
+  with shed-before-dispatch;
+- :mod:`batcher` — :class:`DeadlineBatcher`, flush-on-full-or-urgent
+  over pre-compiled bucket geometries (``data.bucket.edge_for``);
+- :mod:`replica` — :class:`Replica`/:class:`ReplicaPool`: StallWatchdog
+  supervision, fencing, exactly-once failover, background restart;
+- :mod:`ladder` — :class:`DegradationLadder`: bf16 → int8 → reduced
+  top-K tier steps with promote-style hysteresis;
+- :mod:`metrics` — :class:`ServingMetrics` snapshot dict;
+- :mod:`runtime` — :class:`ServingRuntime`, the synchronous clock-driven
+  scheduler gluing them together.
+
+Drill: ``python tools/serve_drill.py`` (committed artifact
+``RESILIENCE_r03.json``).  Docs: docs/SERVING.md "Operating under
+load"; failure semantics in docs/RESILIENCE.md.
+"""
+
+from analytics_zoo_tpu.serving.batcher import (FIXED, AssembledBatch,
+                                               DeadlineBatcher)
+from analytics_zoo_tpu.serving.clock import (Clock, MonotonicClock,
+                                             VirtualClock)
+from analytics_zoo_tpu.serving.ladder import (DegradationLadder,
+                                              LadderPolicy, ServingTier)
+from analytics_zoo_tpu.serving.metrics import ServingMetrics, percentile
+from analytics_zoo_tpu.serving.replica import Replica, ReplicaPool
+from analytics_zoo_tpu.serving.request import (TERMINAL_STATES,
+                                               AdmissionQueue, Request)
+from analytics_zoo_tpu.serving.runtime import ServingRuntime
+
+__all__ = [k for k in dir() if not k.startswith("_")]

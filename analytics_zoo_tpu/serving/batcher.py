@@ -1,0 +1,179 @@
+"""Deadline-aware dynamic batch assembly over compiled geometries.
+
+Batching amortizes dispatch overhead — decisive on a remote accelerator
+where every dispatch pays a fixed round-trip — but waiting to fill a
+batch spends the queued requests' deadline slack.  The classic dynamic-
+batching compromise (Clipper's adaptive batch sizing): flush a bucket
+when it is FULL, or when its most urgent request can no longer afford
+to wait for more arrivals.
+
+Geometry discipline: an online path must never hand XLA a shape it has
+not compiled — a surprise compile is a multi-second latency cliff that
+blows every deadline in the queue.  So assembled batches only ever use
+
+- a time axis from the configured ``bucket_edges`` (the same
+  :func:`analytics_zoo_tpu.data.bucket.edge_for` rule the train-side
+  ``BucketBatcher`` uses, so serving reuses training's compiled
+  geometries), and
+- a batch axis of exactly ``max_batch`` — partial flushes are padded
+  with zero rows and carry ``n_valid`` (the ``Uint8ToBatch`` convention;
+  the runtime slices outputs back).
+
+Flush rule per bucket: let ``t_est`` be the estimated service time of
+that bucket's geometry at the current tier.  Flush when
+``len(bucket) >= max_batch``, or when the earliest deadline in the
+bucket satisfies ``deadline - now <= t_est + slack_margin`` — i.e. the
+urgent request would miss if we waited any longer.  Estimation comes
+from ``service_time(edge, n, tier)``, the same model the drill uses, or
+from an online EWMA of observed service times when none is given.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.data.bucket import edge_for
+from analytics_zoo_tpu.serving.request import AdmissionQueue, Request
+
+#: bucket key for fixed-shape models (no variable axis)
+FIXED = "fixed"
+
+
+@dataclasses.dataclass
+class AssembledBatch:
+    """One device-ready batch: ``requests`` in EDF order, padded
+    ``batch`` dict, the geometry it compiled under, and the dispatch
+    bookkeeping the failover path reads (``redispatched``)."""
+
+    requests: List[Request]
+    batch: Dict[str, Any]
+    edge: Any                       # bucket edge or FIXED
+    n_valid: int
+    tier: int = 0
+    redispatched: bool = False      # exactly-once failover latch
+
+    @property
+    def earliest_deadline(self) -> float:
+        return min(r.deadline_t for r in self.requests)
+
+
+class DeadlineBatcher:
+    """Assemble :class:`AssembledBatch` es from an :class:`AdmissionQueue`.
+
+    ``pad_key`` names the payload leaf padded to the bucket edge; other
+    payload leaves must share a shape within a bucket and are stacked
+    as-is.  ``length_key`` (when set) adds the per-row valid-length
+    vector to the batch — the same contract ``BucketBatcher`` gives the
+    train step.
+    """
+
+    def __init__(self, queue: AdmissionQueue, max_batch: int,
+                 bucket_edges: Optional[Sequence[int]] = None,
+                 pad_key: str = "input",
+                 length_key: Optional[str] = "n_frames",
+                 service_time: Optional[
+                     Callable[[Any, int, int], float]] = None,
+                 slack_margin_s: float = 0.0):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.queue = queue
+        self.max_batch = int(max_batch)
+        self.bucket_edges = (sorted(int(e) for e in bucket_edges)
+                             if bucket_edges else None)
+        self.pad_key = pad_key
+        self.length_key = length_key
+        self.service_time = service_time
+        self.slack_margin_s = float(slack_margin_s)
+        # online EWMA of observed per-(geometry, tier) service time, used
+        # when no explicit model is configured; a geometry with no
+        # observation yet estimates +inf ⇒ always-urgent, so a cold
+        # runtime flushes the first (possibly singleton) batch at once
+        # and bootstraps the estimate from its observed service time
+        self._ewma: Dict[Any, float] = {}
+
+    # -- service-time estimate --------------------------------------------
+    def estimate_s(self, edge: Any, n: int, tier: int) -> float:
+        if self.service_time is not None:
+            return float(self.service_time(edge, n, tier))
+        return self._ewma.get((edge, tier), float("inf"))
+
+    def observe_service_s(self, edge: Any, seconds: float, tier: int = 0,
+                          alpha: float = 0.3) -> None:
+        prev = self._ewma.get((edge, tier))
+        self._ewma[(edge, tier)] = (seconds if prev is None
+                                    else (1 - alpha) * prev + alpha * seconds)
+
+    # -- bucket assignment -------------------------------------------------
+    def bucket_of(self, req: Request) -> Any:
+        if self.bucket_edges is None or req.length is None:
+            return FIXED
+        return edge_for(int(req.length), self.bucket_edges)
+
+    # -- assembly ----------------------------------------------------------
+    def _grouped(self) -> Dict[Any, List[Request]]:
+        """Queued requests grouped by bucket, EDF order within each —
+        a read-only view (requests are NOT popped)."""
+        groups: Dict[Any, List[Request]] = {}
+        for r in self.queue.queued_edf():
+            groups.setdefault(self.bucket_of(r), []).append(r)
+        return groups
+
+    def next_batch(self, tier: int, force: bool = False
+                   ) -> Optional[AssembledBatch]:
+        """Assemble the most urgent flush-ready batch, or ``None`` when
+        every bucket can still afford to wait.  ``force=True`` (drain)
+        flushes the most urgent non-empty bucket regardless of slack.
+        Expired requests are shed first — never dispatched."""
+        self.queue.expire()
+        groups = self._grouped()
+        if not groups:
+            return None
+        now = self.queue.clock.now()
+        ready: List[Any] = []       # (earliest_deadline, edge)
+        for edge, reqs in groups.items():
+            full = len(reqs) >= self.max_batch
+            est = self.estimate_s(edge, min(len(reqs), self.max_batch),
+                                  tier)
+            urgent = (reqs[0].deadline_t - now
+                      <= est + self.slack_margin_s)
+            if full or urgent or force:
+                ready.append((reqs[0].deadline_t, edge))
+        if not ready:
+            return None
+        _, edge = min(ready, key=lambda t: (t[0], str(t[1])))
+        taken = self.queue.pop_edf(
+            predicate=lambda r: self.bucket_of(r) == edge,
+            limit=self.max_batch)
+        return self._collate(taken, edge, tier)
+
+    def _collate(self, reqs: List[Request], edge: Any,
+                 tier: int) -> AssembledBatch:
+        """Pad rows to the bucket edge and the batch axis to
+        ``max_batch`` — both geometries already compiled."""
+        rows, lengths = [], []
+        for r in reqs:
+            arr = np.asarray(r.payload[self.pad_key]
+                             if isinstance(r.payload, dict) else r.payload)
+            if edge is not FIXED:
+                n = min(int(r.length if r.length is not None
+                            else arr.shape[0]), int(edge), arr.shape[0])
+                padded = np.zeros((int(edge),) + arr.shape[1:], arr.dtype)
+                padded[:n] = arr[:n]
+                rows.append(padded)
+                lengths.append(n)
+            else:
+                rows.append(arr)
+                lengths.append(arr.shape[0] if arr.ndim else 0)
+        n_valid = len(rows)
+        pad = self.max_batch - n_valid
+        if pad:
+            rows.extend(np.zeros_like(rows[0]) for _ in range(pad))
+            lengths.extend(0 for _ in range(pad))
+        batch: Dict[str, Any] = {self.pad_key: np.stack(rows)}
+        if edge is not FIXED and self.length_key:
+            batch[self.length_key] = np.asarray(lengths, np.int32)
+        return AssembledBatch(requests=reqs, batch=batch, edge=edge,
+                              n_valid=n_valid, tier=tier)

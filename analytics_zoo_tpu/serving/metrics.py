@@ -1,0 +1,110 @@
+"""Serving metrics: the numbers an operator (and the drill) reads.
+
+Counters and reservoirs only — no wall-clock reads of its own; every
+timestamp comes from the runtime's injected clock, so a virtual-clock
+run produces a bit-deterministic snapshot.  Exported as one plain dict
+(:meth:`ServingMetrics.snapshot`) the drill dumps into
+``RESILIENCE_r03.json`` and an operator would scrape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def percentile(xs: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (deterministic, no interpolation noise
+    across numpy versions); None on empty."""
+    if not xs:
+        return None
+    s = sorted(xs)
+    k = min(len(s) - 1, max(0, int(np.ceil(q / 100.0 * len(s))) - 1))
+    return float(s[k])
+
+
+class ServingMetrics:
+    """Aggregates per-request outcomes and per-dispatch observations."""
+
+    def __init__(self):
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.shed_by_cause: Dict[str, int] = {}
+        self.deadline_misses = 0        # completed but late
+        self.batches = 0
+        self.batch_fill: List[float] = []       # n_valid / max_batch
+        self.queue_depth_samples: List[int] = []
+        self.latency_by_tier: Dict[int, List[float]] = {}
+        self.redispatches = 0
+
+    # -- feed ----------------------------------------------------------------
+    def on_submit(self) -> None:
+        self.submitted += 1
+
+    def on_shed(self, cause: str) -> None:
+        self.shed_by_cause[cause] = self.shed_by_cause.get(cause, 0) + 1
+
+    def on_complete(self, latency_s: float, tier: int, missed: bool) -> None:
+        self.completed += 1
+        self.latency_by_tier.setdefault(int(tier), []).append(
+            float(latency_s))
+        if missed:
+            self.deadline_misses += 1
+
+    def on_fail(self) -> None:
+        self.failed += 1
+
+    def on_batch(self, n_valid: int, max_batch: int,
+                 queue_depth: int) -> None:
+        # redispatches are counted post-dispatch by the runtime (the
+        # failover latch is unknown before the pool runs the batch)
+        self.batches += 1
+        self.batch_fill.append(n_valid / max(max_batch, 1))
+        self.queue_depth_samples.append(int(queue_depth))
+
+    # -- read ----------------------------------------------------------------
+    @property
+    def shed_total(self) -> int:
+        return sum(self.shed_by_cause.values())
+
+    def miss_rate(self) -> Optional[float]:
+        """Deadline-miss rate over all requests with a terminal state:
+        a shed/timed-out request missed its deadline by definition, a
+        completed-late one missed it in the client's hands.  THE number
+        the shedding-vs-baseline comparison uses."""
+        terminal = self.completed + self.failed + self.shed_total
+        if terminal == 0:
+            return None
+        missed = self.deadline_misses + self.failed + self.shed_total
+        return missed / terminal
+
+    def snapshot(self) -> Dict[str, Any]:
+        lat = {
+            str(tier): {
+                "n": len(xs),
+                "p50_s": percentile(xs, 50),
+                "p99_s": percentile(xs, 99),
+                "max_s": max(xs) if xs else None,
+            }
+            for tier, xs in sorted(self.latency_by_tier.items())
+        }
+        return {
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "failed": self.failed,
+            "shed_by_cause": dict(sorted(self.shed_by_cause.items())),
+            "shed_total": self.shed_total,
+            "deadline_misses_completed_late": self.deadline_misses,
+            "deadline_miss_rate": self.miss_rate(),
+            "batches": self.batches,
+            "redispatched_batches": self.redispatches,
+            "mean_batch_fill": (float(np.mean(self.batch_fill))
+                                if self.batch_fill else None),
+            "queue_depth_p50": percentile(
+                [float(x) for x in self.queue_depth_samples], 50),
+            "queue_depth_max": (max(self.queue_depth_samples)
+                                if self.queue_depth_samples else None),
+            "latency_by_tier": lat,
+        }

@@ -1,0 +1,214 @@
+"""Replica health supervision and exactly-once batch failover.
+
+A serving cell runs N replicas of the model (N devices, or N mesh
+shards each presenting as one replica).  A replica can fail two ways
+mid-batch: its forward *raises* (device lost, injected crash), or it
+*wedges* — makes no progress past its
+:class:`~analytics_zoo_tpu.resilience.watchdog.StallWatchdog` deadline
+(the PR-1 failure mode that otherwise blocks the host loop silently).
+Either way the pool
+
+1. **fences** the replica — state ``fenced``, no further dispatches;
+2. **re-dispatches** the in-flight batch to a healthy replica EXACTLY
+   once (``AssembledBatch.redispatched`` latch — a batch that fails its
+   second replica fails its requests with
+   :class:`~analytics_zoo_tpu.resilience.errors.ReplicaWedged` rather
+   than ping-ponging through the whole pool and amplifying overload);
+3. **restarts** the fenced replica in the background — modeled as a
+   ``restart_s`` cooldown on the runtime clock; once it elapses the
+   next dispatch cycle re-admits the replica (and its jit cache is
+   assumed cold, which is why restarts must not be free).
+
+Supervision is PULL-mode :class:`StallWatchdog` on the runtime's clock:
+``beat`` when the forward starts, ``check`` when it returns.  A forward
+whose (possibly virtual) duration exceeds ``wedge_timeout_s`` is a
+wedge even though it eventually returned — in production the push-mode
+monitor thread would have interrupted it mid-flight; on the virtual
+clock the pull check observes the same deadline deterministically.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from analytics_zoo_tpu.resilience.errors import ReplicaWedged, StallError
+from analytics_zoo_tpu.resilience.watchdog import StallWatchdog
+from analytics_zoo_tpu.serving.batcher import AssembledBatch
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+
+class Replica:
+    """One supervised model replica.
+
+    ``forward_fns`` maps degradation-tier index → callable
+    ``batch_dict -> outputs`` (every tier's geometry pre-compiled on
+    this replica's device).  ``service_hook`` (optional) returns the
+    simulated service seconds for a dispatch — the virtual-clock path;
+    when ``None`` the real forward's wall time is what the watchdog
+    sees.
+    """
+
+    def __init__(self, rid: int, forward_fns: Sequence[Callable],
+                 clock, wedge_timeout_s: float,
+                 service_hook: Optional[Callable[..., float]] = None):
+        self.rid = rid
+        self.forward_fns = list(forward_fns)
+        self.clock = clock
+        self.service_hook = service_hook
+        self.state = "healthy"          # healthy|fenced
+        self.restart_at: Optional[float] = None
+        self.dispatches = 0
+        self.wedges = 0
+        self.watchdog = StallWatchdog(
+            timeout_s=wedge_timeout_s, name=f"replica-{rid}",
+            clock=clock.now)
+
+    def forward(self, batch: AssembledBatch,
+                fault: Optional[Callable[["Replica"], None]] = None) -> Any:
+        """Run one batch under stall supervision.  ``fault`` (chaos) runs
+        just before the model fn — it may raise (crash) or advance the
+        virtual clock (slow forward).  Raises :class:`ReplicaWedged` on
+        crash or deadline overrun; the POOL owns fencing/failover."""
+        self.watchdog.beat()
+        self.dispatches += 1
+        t0 = self.clock.now()
+        try:
+            if fault is not None:
+                fault(self)
+            out = self.forward_fns[batch.tier](batch.batch)
+        except ReplicaWedged:
+            raise
+        except Exception as e:
+            raise ReplicaWedged(
+                f"replica {self.rid}: forward crashed mid-batch "
+                f"({type(e).__name__}: {e})") from e
+        if self.service_hook is not None:
+            # virtual time: the hook says how long this forward took
+            self.clock.sleep(float(self.service_hook(
+                batch.edge, batch.n_valid, batch.tier, self.rid)))
+        try:
+            self.watchdog.check()
+        except StallError as e:
+            raise ReplicaWedged(
+                f"replica {self.rid}: forward wedged "
+                f"({self.clock.now() - t0:.3f}s > "
+                f"{self.watchdog.timeout_s:.3f}s deadline)") from e
+        return out
+
+    # -- lifecycle ----------------------------------------------------------
+    def fence(self, restart_at: float) -> None:
+        self.state = "fenced"
+        self.wedges += 1
+        self.restart_at = restart_at
+
+    def maybe_restart(self, now: float) -> bool:
+        """Re-admit the replica once its background restart completed."""
+        if self.state == "fenced" and self.restart_at is not None \
+                and now >= self.restart_at:
+            self.state = "healthy"
+            self.restart_at = None
+            # clear the latched stall verdict + the age accumulated while
+            # fenced, or the revived replica would instantly re-wedge
+            self.watchdog.reset()
+            return True
+        return False
+
+
+class ReplicaPool:
+    """Round-robin dispatch over healthy replicas with fence + exactly-
+    once failover.  ``events`` is the deterministic log the drill banks
+    (no wall-clock entries beyond the runtime clock's virtual time)."""
+
+    def __init__(self, replicas: Sequence[Replica], clock,
+                 restart_s: float = 5.0):
+        if not replicas:
+            raise ValueError("need at least one replica")
+        self.replicas = list(replicas)
+        self.clock = clock
+        self.restart_s = float(restart_s)
+        self.events: List[Dict[str, Any]] = []
+        self._rr = 0
+
+    # -- selection -----------------------------------------------------------
+    def _revive(self) -> None:
+        now = self.clock.now()
+        for r in self.replicas:
+            if r.maybe_restart(now):
+                self.events.append({"kind": "replica_restarted",
+                                    "replica": r.rid, "t": round(now, 6)})
+
+    def healthy(self) -> List[Replica]:
+        self._revive()
+        return [r for r in self.replicas if r.state == "healthy"]
+
+    def pick(self, exclude: Optional[int] = None) -> Optional[Replica]:
+        """Deterministic round-robin over healthy replicas (skipping
+        ``exclude`` — the replica that just failed this batch)."""
+        ready = [r for r in self.healthy() if r.rid != exclude]
+        if not ready:
+            return None
+        r = ready[self._rr % len(ready)]
+        self._rr += 1
+        return r
+
+    # -- dispatch with failover ----------------------------------------------
+    def _fence(self, replica: Replica, err: ReplicaWedged) -> None:
+        restart_at = self.clock.now() + self.restart_s
+        replica.fence(restart_at)
+        self.events.append({"kind": "replica_fenced", "replica": replica.rid,
+                            "t": round(self.clock.now(), 6),
+                            "restart_at": round(restart_at, 6),
+                            "error": str(err).split("\n")[0][:160]})
+        logger.warning("serving: fenced replica %d (%s); restart at t=%.3f",
+                       replica.rid, err, restart_at)
+
+    def dispatch(self, batch: AssembledBatch,
+                 fault_for: Optional[Callable[[Replica], Optional[
+                     Callable[[Replica], None]]]] = None) -> Any:
+        """Run ``batch`` on a healthy replica; on :class:`ReplicaWedged`
+        fence the replica and re-dispatch EXACTLY once.  Returns the
+        forward outputs; raises :class:`ReplicaWedged` when the retry is
+        spent or no healthy replica remains (the runtime fails the
+        batch's requests — retryable from the client's side)."""
+        replica = self.pick()
+        if replica is None:
+            raise ReplicaWedged("no healthy replica available")
+        try:
+            fault = fault_for(replica) if fault_for is not None else None
+            return self.dispatch_on(replica, batch, fault)
+        except ReplicaWedged as err:
+            self._fence(replica, err)
+            if batch.redispatched:
+                raise
+            batch.redispatched = True
+            backup = self.pick(exclude=replica.rid)
+            if backup is None:
+                raise ReplicaWedged(
+                    f"batch failover from replica {replica.rid}: no healthy "
+                    f"replica left") from err
+            self.events.append({"kind": "failover", "from": replica.rid,
+                                "to": backup.rid,
+                                "t": round(self.clock.now(), 6),
+                                "requests": [r.rid for r in batch.requests]})
+            fault = fault_for(backup) if fault_for is not None else None
+            try:
+                return self.dispatch_on(backup, batch, fault)
+            except ReplicaWedged as err2:
+                self._fence(backup, err2)
+                raise
+
+    def dispatch_on(self, replica: Replica, batch: AssembledBatch,
+                    fault: Optional[Callable[[Replica], None]]) -> Any:
+        for req in batch.requests:
+            req.attempts += 1
+        return replica.forward(batch, fault=fault)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "replicas": [{"rid": r.rid, "state": r.state,
+                          "dispatches": r.dispatches, "wedges": r.wedges}
+                         for r in self.replicas],
+            "healthy": sum(r.state == "healthy" for r in self.replicas),
+        }

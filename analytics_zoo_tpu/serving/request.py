@@ -1,0 +1,177 @@
+"""Request objects and the bounded earliest-deadline-first admission queue.
+
+The offline predictors (``SSDPredictor.predict``,
+``DeepSpeech2Pipeline.transcribe_samples``) iterate a dataset they own;
+online serving inverts that: requests arrive when they arrive, each with
+a deadline, and the system must decide *per request* whether serving it
+is still worth device time.  Two overload behaviors, both explicit:
+
+- **queue full** → the submit raises
+  :class:`~analytics_zoo_tpu.resilience.errors.ServerOverloaded`
+  (retryable with backoff) instead of buffering without bound — a
+  client that keeps its queue position honest can hedge elsewhere;
+- **deadline passed while queued** → the request is shed *before* it
+  ever reaches a device (:class:`~analytics_zoo_tpu.resilience.errors.
+  RequestTimeout`), because a late answer costs the same device time as
+  a useful one (the Clipper/Clockwork argument for shedding at the
+  frontier, not after the forward).
+
+Ordering is earliest-deadline-first (EDF): under load the batcher drains
+the requests with the least slack first, which is the order that
+maximizes the number of deadlines met when service times are roughly
+uniform within a shape bucket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+from typing import Any, Callable, Dict, List, Optional
+
+from analytics_zoo_tpu.resilience.errors import (RequestTimeout,
+                                                 ServerOverloaded)
+
+#: terminal request states — the drill's accounting invariant is that
+#: every submitted request ends in exactly one of these (none lost)
+TERMINAL_STATES = ("done", "shed", "timeout", "failed")
+
+
+@dataclasses.dataclass
+class Request:
+    """One inference request.
+
+    ``payload`` is a single sample (e.g. ``{"input": (n, D) array}``).
+    ``length`` is the sample's variable-axis length for bucket
+    assignment (``None`` for fixed-shape models).  ``deadline_t`` is
+    ABSOLUTE clock time; slack = ``deadline_t - now``.
+    """
+
+    rid: int
+    payload: Any
+    arrival_t: float
+    deadline_t: float
+    length: Optional[int] = None
+    state: str = "pending"          # pending|inflight|<terminal>
+    result: Any = None
+    error: Optional[BaseException] = None
+    completed_t: Optional[float] = None
+    tier: Optional[int] = None      # degradation tier that served it
+    attempts: int = 0               # device dispatches (failover ≤ 2)
+
+    @property
+    def finished(self) -> bool:
+        return self.state in TERMINAL_STATES
+
+    def finish(self, state: str, now: float, result: Any = None,
+               error: Optional[BaseException] = None) -> None:
+        if self.finished:
+            raise RuntimeError(f"request {self.rid} already terminal "
+                               f"({self.state})")
+        if state not in TERMINAL_STATES:
+            raise ValueError(f"not a terminal state: {state!r}")
+        self.state = state
+        self.result = result
+        self.error = error
+        self.completed_t = now
+
+
+class AdmissionQueue:
+    """Bounded EDF priority queue with shed-before-dispatch.
+
+    ``capacity`` bounds queued (not yet dispatched) requests; on a full
+    queue :meth:`submit` sheds the arriving request and raises
+    :class:`ServerOverloaded` — after first expiring anything already
+    past its deadline, so a burst arriving behind stale work is not
+    rejected spuriously.  ``on_shed(request, cause)`` observes every
+    shed for metrics.  ``shed_expired=False`` (the drill's no-shedding
+    baseline) disables deadline shedding; the bound still holds.
+    """
+
+    def __init__(self, capacity: int, clock,
+                 on_shed: Optional[Callable[[Request, str], None]] = None,
+                 shed_expired: bool = True):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.clock = clock
+        self.on_shed = on_shed
+        self.shed_expired = shed_expired
+        self._heap: List[Any] = []     # (deadline_t, seq, Request)
+        self._seq = itertools.count()  # FIFO tiebreak for equal deadlines
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    @property
+    def depth(self) -> int:
+        return len(self._heap)
+
+    def _shed(self, req: Request, cause: str,
+              error: BaseException) -> None:
+        req.finish("shed" if cause == "queue_full" else "timeout",
+                   self.clock.now(), error=error)
+        if self.on_shed is not None:
+            self.on_shed(req, cause)
+
+    def expire(self) -> int:
+        """Shed every queued request whose deadline has already passed
+        (it can no longer be served in time; device dispatch would be
+        pure waste).  Called by the batcher before every assembly.
+        Returns the number shed."""
+        if not self.shed_expired:
+            return 0
+        now = self.clock.now()
+        shed = 0
+        # EDF heap ⇒ expired requests are a prefix of the pop order
+        while self._heap and self._heap[0][0] <= now:
+            _, _, req = heapq.heappop(self._heap)
+            self._shed(req, "deadline", RequestTimeout(
+                f"request {req.rid}: deadline passed while queued "
+                f"(deadline_t={req.deadline_t:.3f}, now={now:.3f})"))
+            shed += 1
+        return shed
+
+    def submit(self, req: Request) -> None:
+        """Admit ``req`` or raise :class:`ServerOverloaded` (the request
+        is marked shed with cause ``queue_full`` first, so accounting
+        still sees it)."""
+        self.expire()
+        if len(self._heap) >= self.capacity:
+            err = ServerOverloaded(
+                f"admission queue full ({self.capacity} queued); "
+                f"retry with backoff")
+            self._shed(req, "queue_full", err)
+            raise err
+        heapq.heappush(self._heap, (req.deadline_t, next(self._seq), req))
+
+    def queued_edf(self) -> List[Request]:
+        """Queued requests in EDF order — a read-only view for the
+        batcher's flush decision (the heap is not mutated; seq uniquely
+        tiebreaks equal deadlines so tuple sort never compares Requests)."""
+        return [entry[2] for entry in sorted(self._heap)]
+
+    def pop_edf(self, predicate: Optional[Callable[[Request], bool]] = None,
+                limit: Optional[int] = None) -> List[Request]:
+        """Pop up to ``limit`` requests in EDF order matching
+        ``predicate`` (non-matching requests are kept, order preserved).
+        With no predicate/limit, drains the queue in EDF order."""
+        taken: List[Request] = []
+        kept: List[Any] = []
+        while self._heap and (limit is None or len(taken) < limit):
+            entry = heapq.heappop(self._heap)
+            if predicate is None or predicate(entry[2]):
+                taken.append(entry[2])
+            else:
+                kept.append(entry)
+        for entry in kept:
+            heapq.heappush(self._heap, entry)
+        return taken
+
+    def peek_deadline(self) -> Optional[float]:
+        """Earliest queued deadline (None when empty)."""
+        return self._heap[0][0] if self._heap else None
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"depth": len(self._heap), "capacity": self.capacity,
+                "earliest_deadline": self.peek_deadline()}

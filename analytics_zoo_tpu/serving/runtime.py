@@ -1,0 +1,278 @@
+"""The online serving runtime: request-level API over the predictors.
+
+Glues the pieces into one synchronous, clock-driven scheduler:
+
+- :class:`~analytics_zoo_tpu.serving.request.AdmissionQueue` — bounded,
+  EDF, shed-before-dispatch;
+- :class:`~analytics_zoo_tpu.serving.batcher.DeadlineBatcher` — flush on
+  full-or-urgent over pre-compiled geometries only;
+- :class:`~analytics_zoo_tpu.serving.replica.ReplicaPool` — StallWatchdog
+  supervision, fence, exactly-once failover, background restart;
+- :class:`~analytics_zoo_tpu.serving.ladder.DegradationLadder` — tier
+  step-down under sustained overload, hysteresis step-up;
+- :class:`~analytics_zoo_tpu.serving.metrics.ServingMetrics` — the
+  snapshot dict the drill banks.
+
+Single-threaded on purpose: every scheduling decision happens inside
+:meth:`ServingRuntime.pump`, reading time ONLY through the injected
+clock.  Against a real accelerator the same loop runs on a
+:class:`~analytics_zoo_tpu.serving.clock.MonotonicClock` with jax's
+async dispatch providing the device overlap (the
+``SSDPredictor._detect_device`` contract); under a
+:class:`~analytics_zoo_tpu.serving.clock.VirtualClock` plus a
+``service_time`` model the whole overload/failover story replays
+deterministically — that is what ``tests/test_serving.py`` and
+``tools/serve_drill.py`` pin.
+
+Usage::
+
+    tiers = ssd_serving_tiers(model, param)       # pipelines.ssd hook
+    rt = ServingRuntime(tiers, n_replicas=2, max_batch=8,
+                        queue_capacity=64, default_deadline_s=0.2)
+    req = rt.submit({"input": img})               # may raise ServerOverloaded
+    rt.pump()                                     # run due scheduling work
+    ...
+    rt.drain()                                    # flush everything queued
+    print(rt.metrics.snapshot())
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.resilience.errors import ReplicaWedged
+from analytics_zoo_tpu.serving.batcher import (AssembledBatch,
+                                               DeadlineBatcher)
+from analytics_zoo_tpu.serving.clock import Clock, MonotonicClock
+from analytics_zoo_tpu.serving.ladder import (DegradationLadder,
+                                              LadderPolicy, ServingTier)
+from analytics_zoo_tpu.serving.metrics import ServingMetrics
+from analytics_zoo_tpu.serving.replica import Replica, ReplicaPool
+from analytics_zoo_tpu.serving.request import AdmissionQueue, Request
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+
+class ServingRuntime:
+    """Deadline-aware serving over N supervised replicas.
+
+    ``tiers``: degradation rungs, cheapest last (see
+    ``pipelines.ssd.ssd_serving_tiers`` / ``pipelines.deepspeech2.
+    ds2_serving_tiers``).  ``service_time(edge, n, tier)``: estimated
+    service seconds — REQUIRED with a virtual clock (it also advances
+    it); with the default monotonic clock it may be ``None`` (the
+    batcher then learns an EWMA from observed forwards).
+
+    ``chaos``: an armed :class:`~analytics_zoo_tpu.resilience.chaos.
+    ChaosMonkey` whose serving-kind windows (``slow_forward``,
+    ``replica_crash``) are applied per dispatch index.
+    """
+
+    def __init__(self, tiers: Sequence[ServingTier], n_replicas: int = 2,
+                 clock: Optional[Clock] = None,
+                 queue_capacity: int = 64, max_batch: int = 8,
+                 bucket_edges: Optional[Sequence[int]] = None,
+                 pad_key: str = "input",
+                 length_key: Optional[str] = "n_frames",
+                 default_deadline_s: float = 1.0,
+                 wedge_timeout_s: float = 10.0,
+                 restart_s: float = 5.0,
+                 service_time: Optional[
+                     Callable[[Any, int, int], float]] = None,
+                 slack_margin_s: float = 0.0,
+                 ladder_policy: Optional[LadderPolicy] = None,
+                 decision_every: int = 8,
+                 shed_expired: bool = True,
+                 chaos=None):
+        if not tiers:
+            raise ValueError("need at least one ServingTier")
+        self.tiers = list(tiers)
+        self.clock = clock or MonotonicClock()
+        self.default_deadline_s = float(default_deadline_s)
+        self.max_batch = int(max_batch)
+        self.decision_every = int(decision_every)
+        self.chaos = chaos
+        self.metrics = ServingMetrics()
+        self.requests: List[Request] = []      # every request ever submitted
+        self._rid = itertools.count()
+        self._window_shed = 0
+        self._dispatch_idx = 0                 # chaos serving-fault index
+        self._since_decision = 0
+
+        self.queue = AdmissionQueue(queue_capacity, self.clock,
+                                    on_shed=self._on_shed,
+                                    shed_expired=shed_expired)
+        self.batcher = DeadlineBatcher(
+            self.queue, max_batch, bucket_edges=bucket_edges,
+            pad_key=pad_key, length_key=length_key,
+            service_time=service_time, slack_margin_s=slack_margin_s)
+        self._service_time = service_time
+        virtual = service_time is not None
+
+        def service_hook(edge, n, tier, rid):
+            return service_time(edge, n, tier)
+
+        forward_fns = [t.forward for t in self.tiers]
+        self.pool = ReplicaPool(
+            [Replica(r, forward_fns, self.clock, wedge_timeout_s,
+                     service_hook=service_hook if virtual else None)
+             for r in range(n_replicas)],
+            self.clock, restart_s=restart_s)
+        self.ladder = DegradationLadder(len(self.tiers), ladder_policy)
+
+    # -- shed observer -------------------------------------------------------
+    def _on_shed(self, req: Request, cause: str) -> None:
+        self.metrics.on_shed(cause)
+        self._window_shed += 1
+
+    # -- client API ----------------------------------------------------------
+    def submit(self, payload: Any, deadline_s: Optional[float] = None,
+               length: Optional[int] = None) -> Request:
+        """Admit one request; raises
+        :class:`~analytics_zoo_tpu.resilience.errors.ServerOverloaded`
+        on a full queue (the request is still accounted, state
+        ``shed``).  ``length``: variable-axis length for bucket
+        assignment."""
+        now = self.clock.now()
+        req = Request(rid=next(self._rid), payload=payload, arrival_t=now,
+                      deadline_t=now + (deadline_s if deadline_s is not None
+                                        else self.default_deadline_s),
+                      length=length)
+        self.requests.append(req)
+        self.metrics.on_submit()
+        self.queue.submit(req)          # may raise ServerOverloaded
+        return req
+
+    # -- scheduler -----------------------------------------------------------
+    def pump(self, force: bool = False) -> int:
+        """Run all currently due scheduling work: shed expired requests,
+        assemble and dispatch every flush-ready batch.  Returns the
+        number of batches dispatched.  Call after submits and after
+        advancing the clock."""
+        dispatched = 0
+        while True:
+            batch = self.batcher.next_batch(self.ladder.tier, force=force)
+            if batch is None:
+                # no batch is flush-ready; expiry may still have shed —
+                # that counts toward the current decision window
+                break
+            self._dispatch(batch)
+            dispatched += 1
+        return dispatched
+
+    def drain(self, max_batches: int = 10_000) -> None:
+        """Force-flush everything still queued (shutdown / end of drill):
+        every pending request reaches a terminal state."""
+        for _ in range(max_batches):
+            if self.pump(force=True) == 0 and len(self.queue) == 0:
+                return
+        raise RuntimeError("drain did not converge")
+
+    # -- internals -----------------------------------------------------------
+    def _fault_for(self, replica: Replica) -> Optional[Callable]:
+        """Compose the chaos hooks targeting ``replica`` at the current
+        dispatch index (None when nothing is due)."""
+        if self.chaos is None:
+            return None
+        idx = self._dispatch_idx
+        hooks: List[Callable] = []
+        spec = self.chaos.serving_active("slow_forward", idx, consume=False)
+        if spec is not None and spec.detail.get(
+                "replica", replica.rid) == replica.rid:
+            self.chaos.serving_active("slow_forward", idx)  # record+consume
+            delay = float(spec.detail.get("delay_s", 2.0))
+            hooks.append(lambda r: self.clock.sleep(delay))
+        spec = self.chaos.serving_active("replica_crash", idx, consume=False)
+        if spec is not None and spec.detail.get(
+                "replica", replica.rid) == replica.rid:
+            self.chaos.serving_active("replica_crash", idx)
+
+            def crash(r):
+                from analytics_zoo_tpu.resilience.errors import InjectedFault
+
+                raise InjectedFault(
+                    f"chaos: replica {r.rid} killed mid-batch")
+
+            hooks.append(crash)
+        if not hooks:
+            return None
+
+        def fault(r):
+            for h in hooks:
+                h(r)
+
+        return fault
+
+    def _dispatch(self, batch: AssembledBatch) -> None:
+        self._dispatch_idx += 1
+        self.metrics.on_batch(batch.n_valid, self.max_batch,
+                              self.queue.depth)
+        t0 = self.clock.now()
+        try:
+            out = self.pool.dispatch(batch, fault_for=self._fault_for)
+        except ReplicaWedged as err:
+            now = self.clock.now()
+            for req in batch.requests:
+                req.finish("failed", now, error=err)
+                self.metrics.on_fail()
+            self._after_dispatch(batch, t0, failed=True)
+            return
+        now = self.clock.now()
+        rows = np.asarray(out)
+        for i, req in enumerate(batch.requests):
+            req.tier = batch.tier
+            req.finish("done", now, result=rows[i])
+            self.metrics.on_complete(now - req.arrival_t, batch.tier,
+                                     missed=now > req.deadline_t)
+        self._after_dispatch(batch, t0, failed=False)
+
+    def _after_dispatch(self, batch: AssembledBatch, t0: float,
+                        failed: bool) -> None:
+        dt = self.clock.now() - t0
+        if not failed:
+            self.batcher.observe_service_s(batch.edge, dt, tier=batch.tier)
+        if batch.redispatched:
+            self.metrics.redispatches += 1
+        self._since_decision += 1
+        if self._since_decision >= self.decision_every:
+            self._decide_window()
+
+    def _decide_window(self) -> None:
+        depth_high = (self.ladder.policy.depth_high * self.max_batch)
+        overloaded = (self._window_shed > 0
+                      or self.queue.depth > depth_high)
+        self.ladder.observe_window(
+            overloaded, detail={"shed_in_window": self._window_shed,
+                                "queue_depth": self.queue.depth})
+        self._window_shed = 0
+        self._since_decision = 0
+
+    # -- observability -------------------------------------------------------
+    def accounting(self) -> Dict[str, Any]:
+        """Request-conservation check: every submitted request is in
+        exactly one terminal state once the runtime is drained —
+        ``unaccounted == 0`` is the drill's hard invariant."""
+        by_state: Dict[str, int] = {}
+        for r in self.requests:
+            by_state[r.state] = by_state.get(r.state, 0) + 1
+        terminal = sum(v for k, v in by_state.items()
+                       if k in ("done", "shed", "timeout", "failed"))
+        return {"submitted": len(self.requests), "by_state": by_state,
+                "terminal": terminal,
+                "unaccounted": len(self.requests) - terminal}
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "metrics": self.metrics.snapshot(),
+            "queue": self.queue.snapshot(),
+            "replicas": self.pool.snapshot(),
+            "ladder": self.ladder.snapshot(),
+            "tiers": [{"name": t.name, "speed": t.speed,
+                       "quality_note": t.quality_note}
+                      for t in self.tiers],
+            "accounting": self.accounting(),
+        }

@@ -1143,6 +1143,84 @@ def bench_link_probe(args):
              "is the honest floor. Context for transfer-bound lines.")
 
 
+def bench_serve_sched(args):
+    """Serving-runtime scheduler cost (host-only, no device): (1) how
+    many requests/sec the submit → EDF queue → batch assembly → dispatch
+    loop moves with a no-op forward — the ceiling the host scheduler
+    imposes on one serving cell (it must sit far above any realistic
+    arrival rate, or the scheduler IS the wall); (2) a virtual-clock
+    offered-load sweep (0.5×..4× of tier-0 capacity) recording miss
+    rate, shed fraction and batch fill — the shape of the shedding
+    frontier docs/SERVING.md describes, banked per bench run."""
+    import numpy as np
+
+    from analytics_zoo_tpu.resilience.errors import ServerOverloaded
+    from analytics_zoo_tpu.serving import (ServingRuntime, ServingTier,
+                                           VirtualClock)
+
+    def noop_tier():
+        return [ServingTier("noop",
+                            lambda b: b["input"].reshape(
+                                b["input"].shape[0], -1).sum(axis=1))]
+
+    # -- host scheduler throughput (real wall time, virtual service) ------
+    n = 500 if args.quick else 5000
+    clock = VirtualClock()
+    rt = ServingRuntime(noop_tier(), n_replicas=2, clock=clock,
+                        queue_capacity=256, max_batch=8,
+                        default_deadline_s=1.0, wedge_timeout_s=100.0,
+                        service_time=lambda e, nv, t: 0.0)
+    payload = {"input": np.ones((1, 16), np.float32)}
+    t0 = time.perf_counter()
+    for i in range(n):
+        rt.submit(payload)
+        clock.advance(1e-4)
+        rt.pump()
+    rt.drain()
+    wall = time.perf_counter() - t0
+    assert rt.accounting()["unaccounted"] == 0
+    sched_rps = n / wall
+
+    # -- offered-load sweep on the virtual clock --------------------------
+    service_s, max_batch = 0.08, 8          # capacity = 100 req/s
+    capacity = max_batch / service_s
+    sweep = {}
+    for load_x in (0.5, 1.0, 2.0, 4.0):
+        clock = VirtualClock()
+        rt = ServingRuntime(noop_tier(), n_replicas=1, clock=clock,
+                            queue_capacity=64, max_batch=max_batch,
+                            default_deadline_s=0.3, wedge_timeout_s=100.0,
+                            service_time=lambda e, nv, t: service_s)
+        gap = 1.0 / (capacity * load_x)
+        n_req = 200 if args.quick else 2000
+        for i in range(n_req):
+            # open-loop offered load: deadlines anchor at the SCHEDULED
+            # arrival instant (i * gap), so time the server spent busy
+            # while this request waited to be admitted counts against it
+            t_sched = i * gap
+            if clock.now() < t_sched:
+                clock.advance(t_sched - clock.now())
+            try:
+                rt.submit(payload,
+                          deadline_s=t_sched + 0.3 - clock.now())
+            except ServerOverloaded:    # accounted as shed by the queue
+                pass
+            rt.pump()
+        rt.drain()
+        m = rt.metrics.snapshot()
+        assert rt.accounting()["unaccounted"] == 0
+        sweep[f"{load_x:g}x"] = {
+            "miss_rate": round(m["deadline_miss_rate"], 4),
+            "shed_fraction": round(m["shed_total"] / m["submitted"], 4),
+            "mean_batch_fill": round(m["mean_batch_fill"], 4),
+        }
+    return _emit("serve_sched_requests_per_sec", sched_rps, "req/s", None,
+                 n_requests=n, load_sweep=sweep,
+                 note="host scheduler ceiling (no-op forward, virtual "
+                      "service); load_sweep = shedding frontier vs "
+                      "offered load as a fraction of tier-0 capacity")
+
+
 def bench_detection_output_backends(args):
     """Pallas NMS vs XLA NMS on the same batch: parity + speed, on the
     real chip (VERDICT round-1 item 6)."""
@@ -1343,8 +1421,8 @@ def main() -> int:
     # cheap phases first so a flaky relay still leaves recorded metrics;
     # the link probe leads (it contextualizes every later number);
     # ssd_train stays last (the driver reads the LAST line as headline)
-    ALL_PHASES = ["link", "nms", "ds2", "ds2_train", "ds2_ragged",
-                  "ssd_serve", "ssd512_serve", "frcnn_serve",
+    ALL_PHASES = ["link", "serve_sched", "nms", "ds2", "ds2_train",
+                  "ds2_ragged", "ssd_serve", "ssd512_serve", "frcnn_serve",
                   "frcnn_train", "ssd512_step", "overlap", "host_wall",
                   "ssd_train_hostaug", "ssd_train"]
     if not args.child and not args.no_isolate:
@@ -1515,6 +1593,8 @@ def main() -> int:
             # FIRST in shared-process mode too: after any other phase's
             # readbacks the "pre-ratchet" probe value would be a lie
             bench_link_probe(args)
+        if "serve_sched" not in skip:
+            bench_serve_sched(args)     # host-only, never touches a device
         if "ssd_train" not in skip:
             headline = bench_ssd_train(args, mesh, pattern, device_aug=True)
         if "overlap" not in skip:

@@ -224,6 +224,25 @@ class TestTaxonomyCompleteness:
         assert not is_retryable(CheckpointCorrupt("c"))
         assert not is_retryable(ValueError("v"))
 
+    def test_serving_classes_pinned_retryable(self):
+        """The serving-side taxonomy (PR 5): ServerOverloaded is the
+        explicit bounded-queue rejection (retry WITH backoff — a blind
+        immediate retry re-creates the overload), RequestTimeout is a
+        shed-before-dispatch (resubmit with a fresh deadline), and
+        ReplicaWedged is fatal for the REPLICA (the pool fences it) but
+        retryable for the REQUEST — the error object only ever escapes
+        to request scope, so the registry pins it retryable."""
+        from analytics_zoo_tpu.resilience.errors import (
+            _RETRYABLE_CLASSES, ReplicaWedged, RequestTimeout,
+            ServerOverloaded, is_retryable)
+
+        for cls in (ServerOverloaded, RequestTimeout, ReplicaWedged):
+            assert cls in _RETRYABLE_CLASSES
+            assert is_retryable(cls("x"))
+        # backoff guidance is part of the overload contract the clients
+        # read — keep it in the message
+        assert "backoff" in str(ServerOverloaded.__doc__).lower()
+
     def test_run_resilient_does_not_retry_divergence(self, tmp_path):
         attempts = []
 

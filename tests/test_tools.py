@@ -260,3 +260,45 @@ class TestIngestRealFixture:
         assert report["train"]["images"] == 8
         # scratch paths are scrubbed from the artifact
         assert "<tmp>" in report["conversion"][0]
+
+
+class TestServeDrillHelpers:
+    """tools/serve_drill.py (the committed artifact is the full-size
+    RESILIENCE_r03.json execution; the smoke drill here runs the whole
+    burst -> shed -> degrade -> crash -> failover -> recover story in a
+    few seconds of virtual time)."""
+
+    def test_arrival_script_seeded_and_burst_shaped(self):
+        import random
+
+        from analytics_zoo_tpu.resilience.chaos import ChaosMonkey, FaultSpec
+        from tools.serve_drill import build_arrival_script
+
+        def build():
+            monkey = ChaosMonkey([FaultSpec(
+                "burst_load", 100, batches=150, detail={"rate_x": 4.0})])
+            return build_arrival_script(random.Random(3), True, monkey)
+
+        (a, burst_a), (b, burst_b) = build(), build()
+        assert a == b and burst_a == burst_b      # seeded deterministic
+        assert burst_a["from_index"] == 100
+        assert burst_a["requests_in_window"] == 150
+        # arrival instants are monotone absolute times, and the burst
+        # window really runs ~4x hotter than the surrounding load
+        ts = [t for t, _ in a]
+        assert ts == sorted(ts)
+        pre = ts[99] - ts[0]                      # 100 normal gaps
+        burst = ts[249] - ts[99]                  # 150 burst gaps
+        assert (pre / 100) / (burst / 150) > 2.0
+
+    def test_smoke_drill_all_checks_pass(self):
+        from tools.serve_drill import serving_drill
+
+        out = serving_drill(seed=0, smoke=True)
+        assert out["checks"]["ok"], out["checks"]
+        # the hard invariants, re-asserted explicitly: nothing lost,
+        # and shedding+degradation beat the no-shedding baseline
+        assert out["baseline_no_shedding"]["accounting"]["unaccounted"] == 0
+        assert out["drill"]["accounting"]["unaccounted"] == 0
+        assert (out["miss_rate"]["shedding_plus_degradation"]
+                < out["miss_rate"]["baseline_no_shedding"])
