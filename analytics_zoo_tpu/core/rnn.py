@@ -31,16 +31,33 @@ restructuring (persistent/fused RNNs à la Deep Speech 2, Amodei et al.
 ``hoist=False`` keeps the original per-step ``nn.scan`` body (one tiny
 latency-bound matmul per timestep per gate) — retained as the equivalence
 reference and the A/B baseline of ``bench.py bench_ds2_train``.
+
+**Engines.**  ``Recurrent(engine=...)`` names the recurrence schedule
+explicitly; all three share ONE parameter tree (checkpoints move freely):
+
+- ``"legacy"`` — the per-step ``nn.scan`` body (``hoist=False``);
+- ``"blocked"`` — hoisted projections + time-blocked scan (the default,
+  ``hoist=True``);
+- ``"pallas"`` — the persistent-RNN kernel (``ops.pallas_rnn``): the
+  h2h weights load into VMEM once and the timestep loop runs on-chip,
+  breaking the ≈ B/240 HBM-restream roofline of docs/MFU_CEILING.md
+  (Diamos et al., "Persistent RNNs", ICML 2016).  Falls back to
+  ``"blocked"`` with a warning when the geometry cannot be
+  VMEM-resident (budget formula: ``persistent_vmem_bytes``) or the
+  cell kind is not ported into the kernel.
 """
 
 from __future__ import annotations
 
+import warnings
 from typing import Optional
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from flax.linen import initializers
+
+ENGINES = ("legacy", "blocked", "pallas")
 
 
 def _cell_kwargs(cell: nn.Module) -> dict:
@@ -212,6 +229,40 @@ class LSTMCell(nn.Module):
         return (z, z)
 
 
+def _pallas_cell_kind(cell) -> Optional[str]:
+    """Kernel cell kind for a ``core.rnn`` cell, or None if the cell's
+    gate math is not ported into ``ops.pallas_rnn``."""
+    if isinstance(cell, RnnCell):
+        return "vanilla"
+    if isinstance(cell, GRUCell):
+        return "gru"
+    if isinstance(cell, LSTMCell):
+        return "lstm"
+    return None
+
+
+def _stack_recurrent_params(kind: str, params):
+    """Gate-stack a cell's h2h kernels/biases into the ``[H, k·H]`` /
+    ``[k·H]`` layout ``ops.pallas_rnn`` consumes.  Gate order matches
+    each cell's ``project`` concatenation (vanilla; GRU r,z,n; LSTM
+    i,f,g,o); unbiased gates contribute zero bias columns."""
+    if kind == "vanilla":
+        p = params["h2h"]
+        return p["kernel"], p["bias"]
+    if kind == "gru":
+        g = params["gru"]
+        w = jnp.concatenate(
+            [g["hr"]["kernel"], g["hz"]["kernel"], g["hn"]["kernel"]], 1)
+        H = g["hn"]["bias"].shape[0]
+        b = jnp.concatenate(
+            [jnp.zeros((2 * H,), g["hn"]["bias"].dtype), g["hn"]["bias"]])
+        return w, b
+    l = params["lstm"]
+    w = jnp.concatenate([l[k]["kernel"] for k in ("hi", "hf", "hg", "ho")], 1)
+    b = jnp.concatenate([l[k]["bias"] for k in ("hi", "hf", "hg", "ho")])
+    return w, b
+
+
 def _masked_step(cell, carry, pre_t, m_t):
     """One recurrence step with an optional per-row validity mask: an
     invalid row's carry freezes and its output is zeroed (padding is
@@ -236,13 +287,74 @@ class Recurrent(nn.Module):
     freezes past each row's length, masked outputs are zeros, and
     ``reverse=True`` reverses only the valid prefix.  ``hoist=False`` is
     the original per-step ``nn.scan`` body (equivalence/A-B reference;
-    no masking support).  Both paths share one parameter tree.
+    no masking support).  All engines share one parameter tree.
+
+    ``engine`` names the schedule explicitly ("legacy" | "blocked" |
+    "pallas"); ``None`` derives it from ``hoist`` for backward
+    compatibility.  ``engine="pallas"`` runs ``ops.pallas_rnn``'s
+    persistent kernel (h2h weights VMEM-resident across all timesteps);
+    if the geometry exceeds the VMEM budget (``pallas_vmem_limit``,
+    default ``ops.pallas_rnn.VMEM_BUDGET_BYTES`` — checked only when the
+    kernel would actually compile for a TPU, interpret mode has no VMEM)
+    or the cell kind is not ported, it warns and falls back to the
+    blocked scan, bit-identical results either way.
     """
 
     cell: nn.Module
     reverse: bool = False
     hoist: bool = True
     block_size: int = 16
+    engine: Optional[str] = None
+    pallas_time_block: int = 8
+    pallas_vmem_limit: Optional[int] = None
+    # data-parallel shard count the VMEM estimate divides the jit-global
+    # batch by (each core only holds global/shards rows).  None = the
+    # device count — right for pure data parallelism; set explicitly on
+    # tensor-parallel meshes whose data axis is smaller.
+    pallas_data_shards: Optional[int] = None
+
+    def _resolve_engine(self) -> str:
+        eng = self.engine
+        if eng is None:
+            return "blocked" if self.hoist else "legacy"
+        if eng not in ENGINES:
+            raise ValueError(f"engine={eng!r} not in {ENGINES}")
+        return eng
+
+    def _pallas_or_fallback(self, batch: int, dtype) -> Optional[str]:
+        """Cell kind if the persistent kernel applies, else None (warn +
+        blocked-scan fallback)."""
+        from analytics_zoo_tpu.ops import pallas_rnn
+
+        kind = _pallas_cell_kind(self.cell)
+        if kind is None:
+            warnings.warn(
+                f"engine='pallas' does not support {type(self.cell).__name__}"
+                " — falling back to the blocked scan")
+            return None
+        interp = pallas_rnn.default_interpret()
+        limit = self.pallas_vmem_limit
+        if limit is None:
+            if interp:          # interpret mode discharges to XLA: no VMEM
+                return kind
+            limit = pallas_rnn.VMEM_BUDGET_BYTES
+        # budget against the dtype that will actually compile (fp32 by
+        # default, bf16 under make_train_step(compute_dtype='bf16')
+        # casting) and the PER-DEVICE batch: a pre-sharded global batch
+        # traces with the global row count, but each core only holds
+        # global/shards rows of the streaming working set
+        shards = self.pallas_data_shards or max(jax.device_count(), 1)
+        need = pallas_rnn.persistent_vmem_bytes(
+            self.cell.hidden_size, kind, batch=-(-batch // shards),
+            time_block=self.pallas_time_block,
+            weight_bytes=jnp.dtype(dtype).itemsize)
+        if need > limit:
+            warnings.warn(
+                f"persistent-RNN kernel needs ~{need / 2**20:.1f} MB VMEM "
+                f"(H={self.cell.hidden_size}, {kind}) > budget "
+                f"{limit / 2**20:.1f} MB — falling back to the blocked scan")
+            return None
+        return kind
 
     @nn.compact
     def __call__(self, x, carry0=None, return_carry: bool = False,
@@ -250,12 +362,19 @@ class Recurrent(nn.Module):
         """``carry0``/``return_carry`` expose the scan's boundary state for
         streaming inference (chunked input, state carried across calls);
         params are identical either way."""
-        if not self.hoist:
+        engine = self._resolve_engine()
+        if engine == "legacy":
             if n_frames is not None:
                 raise ValueError(
-                    "length masking (n_frames) requires hoist=True — the "
-                    "legacy per-step scan path has no masked reverse")
+                    "length masking (n_frames) requires hoist=True (the "
+                    "blocked engine) or engine='pallas' — the legacy "
+                    "per-step scan path has no masked reverse")
             return self._legacy_scan(x, carry0, return_carry)
+        if engine == "pallas":
+            kind = self._pallas_or_fallback(x.shape[0], x.dtype)
+            if kind is not None:
+                return self._pallas_scan(x, carry0, return_carry,
+                                         n_frames, kind)
         return self._blocked_scan(x, carry0, return_carry, n_frames)
 
     # -- legacy per-step body (A/B + equivalence reference) ----------------
@@ -282,7 +401,10 @@ class Recurrent(nn.Module):
         B, T, _ = x.shape
         mask = perm = None
         if n_frames is not None:
-            n = jnp.asarray(n_frames, jnp.int32)
+            # clamp to T: a row claiming more frames than the batch holds
+            # would otherwise drive the reverse prefix gather out of
+            # bounds (take_along_axis fills NaN — silent divergence)
+            n = jnp.minimum(jnp.asarray(n_frames, jnp.int32), T)
             t_idx = jnp.arange(T, dtype=jnp.int32)
             mask = t_idx[None, :] < n[:, None]                    # [B, T]
             if self.reverse:
@@ -350,6 +472,52 @@ class Recurrent(nn.Module):
                   if perm is not None else jnp.flip(ys, axis=1))
         return (ys, carry) if return_carry else ys
 
+    # -- persistent-RNN Pallas kernel --------------------------------------
+    def _pallas_scan(self, x, carry0, return_carry, n_frames, kind):
+        """Hoist the input projections exactly like the blocked scan,
+        then hand the whole recurrence to ``ops.pallas_rnn`` — the h2h
+        weights stay VMEM-resident across every timestep instead of
+        re-streaming from HBM per step.  Reverse / length-mask prep is
+        the blocked scan's (prefix gather, not whole-axis flip)."""
+        from analytics_zoo_tpu.ops.pallas_rnn import persistent_rnn
+
+        cell = type(self.cell)(**_cell_kwargs(self.cell), name="body")
+        B, T, _ = x.shape
+        n = perm = None
+        if n_frames is not None:
+            # same clamp as the blocked scan: n > T must not drive the
+            # reverse prefix gather out of bounds (NaN fill)
+            n = jnp.minimum(jnp.asarray(n_frames, jnp.int32), T)
+            if self.reverse:
+                t_idx = jnp.arange(T, dtype=jnp.int32)
+                mask = t_idx[None, :] < n[:, None]
+                perm = jnp.where(mask, n[:, None] - 1 - t_idx[None, :],
+                                 t_idx[None, :])
+                x = jnp.take_along_axis(x, perm[..., None], axis=1)
+        elif self.reverse:
+            x = jnp.flip(x, axis=1)
+
+        pre = cell.project(x)              # ONE [B·T, D]→[B·T, kH] matmul
+        carry = (carry0 if carry0 is not None
+                 else cell.initial_carry(B, x.dtype))
+        if self.is_initializing():
+            # one throwaway step creates the h2h params with the exact
+            # same names/shapes/init as the scan engines (shared tree)
+            cell.recur(carry, pre[:, 0])
+        w, b = _stack_recurrent_params(kind, self.variables["params"]["body"])
+        h0 = jnp.stack(carry) if isinstance(carry, tuple) \
+            else carry[None]
+        act = getattr(self.cell, "activation", "relu")
+        ys, cf = persistent_rnn(pre, w, b, h0, n, cell=kind,
+                                activation=act,
+                                time_block=self.pallas_time_block)
+        if self.reverse:
+            ys = (jnp.take_along_axis(ys, perm[..., None], axis=1)
+                  if perm is not None else jnp.flip(ys, axis=1))
+        final = tuple(cf[i] for i in range(cf.shape[0])) \
+            if isinstance(carry, tuple) else cf[0]
+        return (ys, final) if return_carry else ys
+
 
 class BiRecurrent(nn.Module):
     """Bidirectional recurrence, forward + time-reversed backward pass.
@@ -369,14 +537,23 @@ class BiRecurrent(nn.Module):
     merge: str = "sum"  # 'sum' | 'concat'
     hoist: bool = True
     block_size: int = 16
+    engine: Optional[str] = None
+    pallas_time_block: int = 8
+    pallas_data_shards: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, n_frames=None):
         fwd = Recurrent(cell=self.cell, hoist=self.hoist,
-                        block_size=self.block_size, name="fwd")(
+                        block_size=self.block_size, engine=self.engine,
+                        pallas_time_block=self.pallas_time_block,
+                        pallas_data_shards=self.pallas_data_shards,
+                        name="fwd")(
             x, n_frames=n_frames)
         bwd = Recurrent(cell=self.cell, reverse=True, hoist=self.hoist,
-                        block_size=self.block_size, name="bwd")(
+                        block_size=self.block_size, engine=self.engine,
+                        pallas_time_block=self.pallas_time_block,
+                        pallas_data_shards=self.pallas_data_shards,
+                        name="bwd")(
             x, n_frames=n_frames)
         if self.merge == "sum":
             return fwd + bwd

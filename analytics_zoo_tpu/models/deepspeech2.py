@@ -87,6 +87,12 @@ class DeepSpeech2(nn.Module):
     # parameter tree is identical either way.
     rnn_hoist: bool = True
     rnn_block: int = 16
+    # recurrence engine override ("legacy" | "blocked" | "pallas"); None
+    # derives from rnn_hoist.  "pallas" runs the persistent-RNN kernel
+    # (ops.pallas_rnn — h2h weights VMEM-resident across timesteps, the
+    # docs/MFU_CEILING.md ceiling-raising lever); params are identical
+    # across engines, so checkpoints move freely.
+    rnn_engine: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, n_frames=None, train: bool = False, carry=None,
@@ -107,8 +113,11 @@ class DeepSpeech2(nn.Module):
         streaming = carry is not None or return_carry
         if streaming and self.bidirectional:
             raise ValueError("streaming requires bidirectional=False")
-        if n_frames is not None and not self.rnn_hoist:
-            raise ValueError("n_frames masking requires rnn_hoist=True")
+        legacy_rnn = (self.rnn_engine == "legacy"
+                      or (self.rnn_engine is None and not self.rnn_hoist))
+        if n_frames is not None and legacy_rnn:
+            raise ValueError("n_frames masking requires rnn_hoist=True "
+                             "(or rnn_engine in ('blocked', 'pallas'))")
         B, T, F = x.shape
         h = x[..., None]                                  # (B, T, F, 1)
         # conv front-end: stride 2 in time halves T (DS2 conv1 11x13-ish
@@ -140,11 +149,13 @@ class DeepSpeech2(nn.Module):
                 h = BiRecurrent(cell=cell, merge="sum",
                                 hoist=self.rnn_hoist,
                                 block_size=self.rnn_block,
+                                engine=self.rnn_engine,
                                 name=f"birnn{i}")(h, n_frames=out_n)
             else:
                 h0 = carry["h"][i] if carry is not None else None
                 h, hN = Recurrent(cell=cell, hoist=self.rnn_hoist,
                                   block_size=self.rnn_block,
+                                  engine=self.rnn_engine,
                                   name=f"rnn{i}")(
                     h, carry0=h0, return_carry=True, n_frames=out_n)
                 new_h.append(hN)

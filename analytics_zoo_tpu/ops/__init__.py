@@ -25,6 +25,10 @@ from analytics_zoo_tpu.ops.multibox_loss import (
     multibox_loss,
 )
 from analytics_zoo_tpu.ops.frcnn import FrcnnPostParam, frcnn_postprocess
+from analytics_zoo_tpu.ops.pallas_rnn import (
+    persistent_rnn,
+    persistent_vmem_bytes,
+)
 from analytics_zoo_tpu.ops.anchor import generate_base_anchors, shift_anchors
 from analytics_zoo_tpu.ops.proposal import ProposalParam, proposal
 from analytics_zoo_tpu.ops.roi_pool import roi_pool, roi_pool_batch

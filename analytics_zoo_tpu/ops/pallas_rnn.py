@@ -1,0 +1,336 @@
+"""Pallas TPU kernel: persistent RNN recurrence (VMEM-resident h2h).
+
+The structural DS2 training ceiling named by docs/MFU_CEILING.md: a
+scan-formulated recurrence re-streams the 2·H² h2h weight bytes from HBM
+every timestep, so the h2h matmul's arithmetic intensity is ≈ B FLOP/byte
+against the v5e ridge of ≈ 240 — the MFU ceiling is ~B/240 no matter how
+good the schedule is.  This kernel is the Diamos et al. "Persistent RNNs"
+(ICML 2016) answer restated for TPU/Pallas: load a direction's h2h weight
+block into VMEM **once** and iterate the whole timestep loop on-chip, so
+the weights are read from HBM once per sequence instead of once per step
+— intensity becomes ≈ B·T/2 FLOP/byte, decoupled from batch size.
+
+Mechanics
+---------
+* The grid iterates over time blocks; the weight/bias/carry BlockSpecs
+  use a **constant index map**, so Pallas keeps them VMEM-resident across
+  grid steps (no re-fetch — the revisited block is not re-DMA'd) while the
+  per-block input projections / outputs stream through double-buffered
+  VMEM windows.  The running carry lives in VMEM scratch, which persists
+  across the (sequential) TPU grid.
+* The kernel consumes the already-hoisted input projections
+  (``core.rnn`` fast path: ``[B·T, D] → [B·T, k·H]`` computed before the
+  scan), so the body is exactly the h2h recurrence + gate math.
+* Cell math is ported into the kernel body for the three ``core.rnn``
+  cells: ``vanilla`` (ReLU / clipped-ReLU / tanh — the identity-i2h
+  clipped-ReLU cell is what DS2 actually runs), ``gru`` and ``lstm``,
+  with the same gate order as the hoisted projections (r,z,n / i,f,g,o).
+* ``n_frames`` length masking matches ``core.rnn._masked_step``: a row's
+  carry freezes past its true length and masked outputs are zeroed, so
+  zero-padding (bucket padding AND time-block padding) is
+  correctness-inert.  The reverse direction is handled by the caller
+  (``Recurrent``) with the same prefix-gather used by the blocked scan.
+* ``interpret=True`` (the default off-TPU) discharges the kernel to
+  plain XLA ops, so CPU tier-1 pins fwd+grad equivalence against the
+  blocked scan (tests/test_pallas_rnn.py) — the ``ops.pallas_nms``
+  pattern.
+* Backward: ``jax.custom_vjp`` whose bwd recomputes the recurrence with
+  a differentiable ``lax.scan`` of the identical fp32 math and pulls
+  cotangents through it (checkpoint-style recomputation — the residuals
+  are just the kernel *inputs*, never the per-step gate activations).
+  Grad parity against the blocked scan is the acceptance gate.
+
+Alignment: H pads up to the 128-lane multiple **per gate segment**, B to
+the 8-sublane multiple, T to the time block.  Padded weight rows/columns
+are zero, padded batch rows carry n=0, so padding never contaminates
+real outputs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# gates per cell (k: width multiple of the stacked h2h matmul) and carry
+# slots (C: vanilla/gru carry h; lstm carries (c, h))
+CELL_GATES = {"vanilla": 1, "gru": 3, "lstm": 4}
+CELL_CARRY = {"vanilla": 1, "gru": 1, "lstm": 2}
+
+# VMEM budget the persistent kernel may plan against: 16 MB/core on v4/v5
+# minus headroom for Mosaic's own buffers and semaphores
+VMEM_BUDGET_BYTES = 14 * (1 << 20)
+
+
+class RnnKernelConfig(NamedTuple):
+    """Hashable static config (``custom_vjp`` nondiff argument)."""
+
+    cell: str               # 'vanilla' | 'gru' | 'lstm'
+    activation: str         # vanilla only: 'relu' | 'clipped_relu' | 'tanh'
+    time_block: int         # unrolled steps per grid iteration
+    interpret: bool
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def default_interpret() -> bool:
+    """Interpret (discharge to XLA) unless a real TPU backend is active —
+    the ``ops.pallas_nms`` convention that makes CPU tier-1 run the
+    kernel semantics."""
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def persistent_vmem_bytes(hidden: int, cell: str = "vanilla",
+                          batch: int = 8, time_block: int = 8,
+                          weight_bytes: int = 4) -> int:
+    """Planning estimate of the kernel's VMEM residency: the persistent
+    weight block (the ``2·k·H²`` bf16 formula of docs/PERFORMANCE.md is
+    this term for a fwd+bwd direction pair at ``weight_bytes=2``) plus
+    the streaming working set (double-buffered pre/ys blocks, fp32
+    carry scratch).  Used by ``core.rnn.Recurrent`` to fall back to the
+    blocked scan when a geometry cannot be VMEM-resident."""
+    k = CELL_GATES[cell]
+    c = CELL_CARRY[cell]
+    hp = _round_up(hidden, 128)
+    bp = _round_up(batch, 8)
+    w = k * hp * hp * weight_bytes + k * hp * weight_bytes   # weights+bias
+    stream = 2 * bp * time_block * (k + 1) * hp * 4          # pre+ys ×2 buf
+    carry = (2 * c + 1) * bp * hp * 4                        # h0/out/scratch
+    return w + stream + carry
+
+
+def _gate_slices(a, k: int, hp: int):
+    return [a[:, s * hp:(s + 1) * hp] for s in range(k)]
+
+
+def _cell_step(cfg: RnnKernelConfig, pre_t, hh, carry):
+    """One step of gate math from the input projection ``pre_t`` and the
+    recurrent projection ``hh`` (both fp32, gate-stacked).  Returns
+    (new_carry, output).  The math mirrors ``core.rnn``'s ``recur``
+    methods exactly (same gate order, same biased/unbiased split)."""
+    hp = carry[0].shape[-1]
+    if cfg.cell == "vanilla":
+        z = pre_t + hh
+        if cfg.activation == "relu":
+            act = jnp.maximum(z, 0.0)
+        elif cfg.activation == "clipped_relu":
+            act = jnp.clip(z, 0.0, 20.0)
+        else:
+            act = jnp.tanh(z)
+        return (act,), act
+    if cfg.cell == "gru":
+        (h,) = carry
+        i_r, i_z, i_n = _gate_slices(pre_t, 3, hp)
+        h_r, h_z, h_n = _gate_slices(hh, 3, hp)
+        r = jax.nn.sigmoid(i_r + h_r)
+        z = jax.nn.sigmoid(i_z + h_z)
+        n = jnp.tanh(i_n + r * h_n)
+        new_h = (1.0 - z) * n + z * h
+        return (new_h,), new_h
+    # lstm — gate order (i, f, g, o), carry (c, h)
+    c, h = carry
+    i_i, i_f, i_g, i_o = _gate_slices(pre_t, 4, hp)
+    h_i, h_f, h_g, h_o = _gate_slices(hh, 4, hp)
+    i = jax.nn.sigmoid(i_i + h_i)
+    f = jax.nn.sigmoid(i_f + h_f)
+    g = jnp.tanh(i_g + h_g)
+    o = jax.nn.sigmoid(i_o + h_o)
+    new_c = f * c + i * g
+    new_h = o * jnp.tanh(new_c)
+    return (new_c, new_h), new_h
+
+
+def _rnn_kernel(pre_ref, w_ref, b_ref, h0_ref, n_ref, ys_ref, cf_ref,
+                h_scr, *, cfg: RnnKernelConfig):
+    """Grid step: advance the carry through ``time_block`` timesteps.
+
+    ``w_ref``/``b_ref``/``h0_ref``/``n_ref`` have constant index maps —
+    VMEM-resident for the whole sequence; ``pre_ref``/``ys_ref`` stream
+    per block.  The carry persists in ``h_scr`` across grid steps."""
+    C = h_scr.shape[0]
+    tb = pre_ref.shape[1]
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        h_scr[:] = h0_ref[:].astype(jnp.float32)
+
+    w = w_ref[:]
+    b = b_ref[:].astype(jnp.float32)
+    # per-row valid lengths arrive lane-replicated (B, 128) so the array
+    # is a legal VMEM block; collapse to a (B, 1) column for broadcasting
+    n_col = jnp.max(n_ref[:], axis=1, keepdims=True)
+    t0 = pl.program_id(0) * tb
+    for u in range(tb):
+        keep = n_col > (t0 + u)
+        carry = tuple(h_scr[i] for i in range(C))
+        h = carry[-1]
+        hh = jnp.dot(h.astype(w.dtype), w,
+                     preferred_element_type=jnp.float32) + b
+        pre_t = pre_ref[:, u, :].astype(jnp.float32)
+        new_carry, y = _cell_step(cfg, pre_t, hh, carry)
+        # _masked_step semantics: invalid rows freeze the carry and emit 0
+        for i in range(C):
+            h_scr[i] = jnp.where(keep, new_carry[i], carry[i])
+        ys_ref[:, u, :] = jnp.where(keep, y, 0.0).astype(ys_ref.dtype)
+    cf_ref[:] = h_scr[:].astype(cf_ref.dtype)
+
+
+def _pad_gated(a, h: int, hp: int, k: int, axis: int):
+    """Pad the gate-stacked trailing axis [..., k·h] → [..., k·hp] with
+    zeros per gate segment (so static kernel slices at hp multiples hit
+    gate boundaries)."""
+    if h == hp:
+        return a
+    shape = a.shape[:axis] + (k, h)
+    pad = [(0, 0)] * (len(shape))
+    pad[-1] = (0, hp - h)
+    return jnp.pad(a.reshape(shape), pad).reshape(
+        a.shape[:axis] + (k * hp,))
+
+
+def _run_kernel(cfg: RnnKernelConfig, pre, w, b, h0, n):
+    """Pad/align, invoke the kernel, un-pad.  Shapes:
+    pre [B, T, k·H], w [H, k·H], b [k·H], h0 [C, B, H], n [B] int32.
+    Returns ys [B, T, H], carry [C, B, H]."""
+    k, c = CELL_GATES[cfg.cell], CELL_CARRY[cfg.cell]
+    B, T, _ = pre.shape
+    H = w.shape[0]
+    tb = max(1, int(cfg.time_block))
+    hp, bp = _round_up(H, 128), _round_up(B, 8)
+    tp = _round_up(T, tb)
+    dt = pre.dtype
+
+    pre_p = _pad_gated(pre, H, hp, k, axis=2)
+    pre_p = jnp.pad(pre_p, ((0, bp - B), (0, tp - T), (0, 0)))
+    w_p = _pad_gated(w, H, hp, k, axis=1)
+    w_p = jnp.pad(w_p, ((0, hp - H), (0, 0)))
+    b_p = _pad_gated(b[None, :], H, hp, k, axis=1)
+    h0_p = jnp.pad(h0.astype(jnp.float32),
+                   ((0, 0), (0, bp - B), (0, hp - H)))
+    # padded batch rows get n=0: carry frozen at h0, outputs zero
+    n_p = jnp.pad(jnp.minimum(n, T).astype(jnp.int32), (0, bp - B))
+    n_b = jnp.broadcast_to(n_p[:, None], (bp, 128))
+
+    const3 = lambda t: (0, 0, 0)  # noqa: E731
+    const2 = lambda t: (0, 0)     # noqa: E731
+    ys, cf = pl.pallas_call(
+        functools.partial(_rnn_kernel, cfg=cfg),
+        grid=(tp // tb,),
+        in_specs=[
+            pl.BlockSpec((bp, tb, k * hp), lambda t: (0, t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((hp, k * hp), const2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k * hp), const2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, bp, hp), const3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((bp, 128), const2, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((bp, tb, hp), lambda t: (0, t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, bp, hp), const3, memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bp, tp, hp), dt),
+            jax.ShapeDtypeStruct((c, bp, hp), dt),
+        ],
+        scratch_shapes=[pltpu.VMEM((c, bp, hp), jnp.float32)],
+        interpret=cfg.interpret,
+    )(pre_p, w_p, b_p, h0_p, n_b)
+    return ys[:B, :T, :H], cf[:, :B, :H]
+
+
+def _scan_reference(cfg: RnnKernelConfig, pre, w, b, h0, n):
+    """Differentiable ``lax.scan`` of the identical fp32 recurrence —
+    the backward pass recomputes through this (and tests may compare
+    against it directly).  Math, gate order and masking are the same
+    as the kernel body; only the schedule differs."""
+    B, T, _ = pre.shape
+    dt = pre.dtype
+    n_col = jnp.minimum(n, T).astype(jnp.int32)[:, None]
+    carry0 = tuple(h0[i].astype(jnp.float32)
+                   for i in range(CELL_CARRY[cfg.cell]))
+
+    def step(carry, inp):
+        pre_t, t = inp
+        keep = n_col > t
+        h = carry[-1]
+        hh = jnp.dot(h.astype(w.dtype), w,
+                     preferred_element_type=jnp.float32)
+        hh = hh + b.astype(jnp.float32)
+        new_carry, y = _cell_step(cfg, pre_t.astype(jnp.float32), hh, carry)
+        new_carry = tuple(jnp.where(keep, nw, old)
+                          for nw, old in zip(new_carry, carry))
+        return new_carry, jnp.where(keep, y, 0.0)
+
+    xs = (pre.transpose(1, 0, 2), jnp.arange(T, dtype=jnp.int32))
+    final, ys = jax.lax.scan(step, carry0, xs)
+    return (ys.transpose(1, 0, 2).astype(dt),
+            jnp.stack(final).astype(dt))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _persistent(cfg: RnnKernelConfig, pre, w, b, h0, n):
+    return _run_kernel(cfg, pre, w, b, h0, n)
+
+
+def _persistent_fwd(cfg, pre, w, b, h0, n):
+    # residuals are the INPUTS only — per-step activations rematerialize
+    # in the backward's reference scan (checkpointed recomputation)
+    return _run_kernel(cfg, pre, w, b, h0, n), (pre, w, b, h0, n)
+
+
+def _persistent_bwd(cfg, res, g):
+    pre, w, b, h0, n = res
+    _, vjp = jax.vjp(
+        lambda pre, w, b, h0: _scan_reference(cfg, pre, w, b, h0, n),
+        pre, w, b, h0)
+    d_pre, d_w, d_b, d_h0 = vjp(g)
+    return (d_pre, d_w, d_b, d_h0,
+            np.zeros(n.shape, jax.dtypes.float0))
+
+
+_persistent.defvjp(_persistent_fwd, _persistent_bwd)
+
+
+def persistent_rnn(pre: jax.Array, w: jax.Array, b: jax.Array,
+                   h0: jax.Array, n_frames: Optional[jax.Array] = None,
+                   *, cell: str = "vanilla", activation: str = "relu",
+                   time_block: int = 8,
+                   interpret: Optional[bool] = None
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Run one direction's recurrence with the h2h weights VMEM-resident.
+
+    Args:
+      pre: ``[B, T, k·H]`` hoisted input projections (gate-stacked in the
+        cell's canonical order: vanilla k=1; GRU ``r,z,n``; LSTM
+        ``i,f,g,o`` — what ``core.rnn`` cells' ``project`` emits).
+      w: ``[H, k·H]`` gate-stacked h2h kernel.
+      b: ``[k·H]`` gate-stacked h2h bias (zeros for unbiased gates).
+      h0: ``[C, B, H]`` initial carry (vanilla/GRU C=1: ``(h,)``; LSTM
+        C=2: ``(c, h)``).
+      n_frames: optional ``[B]`` int32 valid lengths — the carry freezes
+        and outputs zero past each row's length (``_masked_step``
+        semantics).  ``None`` = all frames valid.
+      cell / activation / time_block: static kernel config.
+      interpret: force interpreter mode; default: on unless a TPU
+        backend is active.
+
+    Returns ``(ys [B, T, H], carry [C, B, H])``.
+    """
+    if cell not in CELL_GATES:
+        raise ValueError(f"unknown cell kind {cell!r}")
+    B, T, _ = pre.shape
+    if n_frames is None:
+        n_frames = jnp.full((B,), T, jnp.int32)
+    cfg = RnnKernelConfig(
+        cell=cell, activation=activation, time_block=int(time_block),
+        interpret=default_interpret() if interpret is None else interpret)
+    return _persistent(cfg, pre, w, b, jnp.asarray(h0),
+                       jnp.asarray(n_frames, jnp.int32))

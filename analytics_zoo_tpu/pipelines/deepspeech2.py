@@ -280,15 +280,20 @@ class DeepSpeech2Pipeline:
 def make_ds2_model(hidden: int = 1024, n_rnn_layers: int = 3,
                    n_mels: int = 13, utt_length: int = 300,
                    seed: int = 0, bidirectional: bool = True,
-                   rnn_hoist: bool = True, rnn_block: int = 16) -> Model:
+                   rnn_hoist: bool = True, rnn_block: int = 16,
+                   rnn_engine: Optional[str] = None) -> Model:
     """``bidirectional=False`` builds the forward-only (streamable)
     variant consumed by :class:`StreamingDS2`.  ``rnn_hoist=False``
     selects the legacy per-step scan body (the bench A/B baseline);
-    the parameter tree is identical either way, so checkpoints move
-    freely between the two."""
+    ``rnn_engine`` overrides the recurrence engine explicitly
+    ("legacy" | "blocked" | "pallas" — "pallas" is the persistent-RNN
+    kernel of ``ops.pallas_rnn``, which ``train_ds2`` consumes through
+    the model).  The parameter tree is identical across engines, so
+    checkpoints move freely between them."""
     model = Model(DeepSpeech2(hidden=hidden, n_rnn_layers=n_rnn_layers,
                               n_mels=n_mels, bidirectional=bidirectional,
-                              rnn_hoist=rnn_hoist, rnn_block=rnn_block))
+                              rnn_hoist=rnn_hoist, rnn_block=rnn_block,
+                              rnn_engine=rnn_engine))
     model.build(seed, jnp.zeros((1, utt_length, n_mels)))
     return model
 
@@ -339,7 +344,11 @@ def train_ds2(model: Model, dataset, epochs: int = 10, lr: float = 3e-4,
     Length-bucketed batches (``load_asr_train_set(bucket_edges=...)``)
     instead carry ``"input": ((B,T_bucket,n_mels), n_frames)`` — the model
     length-masks padding, the CTC loss masks invalid output frames, and
-    step metrics gain ``padding_efficiency``.
+    step metrics gain ``padding_efficiency``.  The recurrence engine is
+    the model's: build with ``make_ds2_model(rnn_engine="pallas")`` to
+    train on the persistent-RNN kernel (h2h weights VMEM-resident —
+    the docs/MFU_CEILING.md roofline lever; ``bench.py ds2_persistent``
+    banks the A/B against the blocked scan).
     ``param_rules`` enables tensor-parallel weight sharding
     (``parallel.tensor.default_tp_rules``) on a data×model mesh.
 

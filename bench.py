@@ -39,6 +39,7 @@ import os
 import sys
 import tempfile
 import time
+import warnings
 
 
 # Labeled estimate, NOT a published number: 4 executors x 28 cores x
@@ -84,12 +85,43 @@ def _interleaved_ab(fn_a, fn_b, windows: int = 3, on_pair=None):
     return a_rates, b_rates, ratios
 
 
+def _flops_per_record(step, state, dev_batches, recs):
+    """Blended FLOPs per processed record: XLA's compiled FLOP count per
+    pinned batch SHAPE (tools/profile_mfu.flops_of — the shared cost
+    model, not re-derived), weighted by how many batches run at that
+    shape.  Basis of the per-window ``mfu_est`` readouts."""
+    from tools.profile_mfu import flops_of
+
+    by_shape = {}
+    for b in dev_batches:
+        x = b["input"][0] if isinstance(b["input"], tuple) else b["input"]
+        cnt, ex = by_shape.get(x.shape, (0, b))
+        by_shape[x.shape] = (cnt + 1, ex)
+    fl = sum(flops_of(step, state, ex, 1.0) * cnt
+             for cnt, ex in by_shape.values())
+    return fl / max(recs, 1)
+
+
+# every emitted line is also appended here (jsonl) so exploratory sweeps
+# accumulate under bench_artifacts/ instead of littering the repo root
+# with per-run BENCH_rNN_*.jsonl files; only the canonical per-round
+# BENCH_rNN.json artifacts live at top level.  Set by --sweep-log.
+_SWEEP_LOG = None
+
+
 def _emit(metric: str, value: float, unit: str, vs_baseline, **extra):
     line = {"metric": metric, "value": round(float(value), 3), "unit": unit,
             "vs_baseline": (round(float(vs_baseline), 3)
                             if vs_baseline is not None else None)}
     line.update(extra)
     print(json.dumps(line), flush=True)
+    if _SWEEP_LOG:
+        try:
+            os.makedirs(os.path.dirname(_SWEEP_LOG) or ".", exist_ok=True)
+            with open(_SWEEP_LOG, "a") as f:
+                f.write(json.dumps(line) + "\n")
+        except OSError:
+            pass                      # the log is a convenience, never fatal
     return line
 
 
@@ -444,6 +476,50 @@ def _ds2_ragged_lengths(n_records: int, n_frames_max: int, seed: int = 42):
     return np.clip((frac * n_frames_max).astype(np.int32), 16, n_frames_max)
 
 
+def _ds2_ragged_workload(args, n_max):
+    """Seeded ragged DS2 workload SHARED by the ds2_ragged and
+    ds2_persistent phases (one synthesis = the two A/Bs measure the
+    same distribution): lognormal lengths, random mel features/labels,
+    quantile bucket edges, and the production ``BucketBatcher``
+    assembly with ``(x, n_frames)`` inputs at its drop_remainder=True
+    default.  Returns ``(B, lengths, feats, labels, lab_mask, edges,
+    bucketed_batches)``."""
+    import numpy as np
+    import jax
+
+    from analytics_zoo_tpu.data.bucket import BucketBatcher
+
+    n_dev = max(jax.device_count(), 1)
+    B = args.ds2_train_batch if args.ds2_train_batch else 4 * args.ds2_batch
+    B = ((B + n_dev - 1) // n_dev) * n_dev
+    n_records = B * 16
+    lengths = _ds2_ragged_lengths(n_records, n_max)
+    L = 20
+    rng = np.random.RandomState(0)
+    feats = [rng.randn(int(n), 13).astype(np.float32) * 0.1
+             for n in lengths]
+    labels = rng.randint(1, 29, (n_records, L)).astype(np.int32)
+    lab_mask = np.ones((n_records, L), np.float32)
+    # quantile-derived pinned bucket edges (the jit cache warms once per
+    # edge); last edge = the max so nothing truncates
+    qs = np.quantile(lengths, np.linspace(1.0 / args.ds2_buckets, 1.0,
+                                          args.ds2_buckets))
+    edges = sorted(set(int(np.ceil(q)) for q in qs) | {int(lengths.max())})
+
+    def sample_stream():
+        for i in range(n_records):
+            yield {"input": feats[i], "n_frames": np.int32(lengths[i]),
+                   "labels": labels[i], "label_mask": lab_mask[i]}
+
+    batches = []
+    for b in BucketBatcher(B, edges).apply_iter(sample_stream()):
+        batches.append({"input": (b["input"], b["n_frames"]),
+                        "n_frames": b["n_frames"],
+                        "labels": b["labels"],
+                        "label_mask": b["label_mask"]})
+    return B, lengths, feats, labels, lab_mask, edges, batches
+
+
 def bench_ds2_ragged(args, mesh):
     """DS2 RNN training fast path A/B on a RAGGED-length workload —
     the bench_ds2_train honesty fix: that phase re-feeds ONE resident
@@ -468,8 +544,7 @@ def bench_ds2_ragged(args, mesh):
     import numpy as np
     import jax
 
-    from analytics_zoo_tpu.data.bucket import (BucketBatcher,
-                                               padding_efficiency)
+    from analytics_zoo_tpu.data.bucket import padding_efficiency
     from analytics_zoo_tpu.parallel import (Adam, create_train_state,
                                             make_train_step, replicate)
     from analytics_zoo_tpu.parallel import mesh as mesh_lib
@@ -481,25 +556,17 @@ def bench_ds2_ragged(args, mesh):
     sec = args.ds2_seconds
     n_max = (16000 * sec - WINDOW_SIZE) // WINDOW_STRIDE + 1
     n_dev = max(jax.device_count(), 1)
-    B = args.ds2_train_batch if args.ds2_train_batch else 4 * args.ds2_batch
-    B = ((B + n_dev - 1) // n_dev) * n_dev
-    n_batches = 16
-    n_records = B * n_batches
-    lengths = _ds2_ragged_lengths(n_records, n_max)
-    L = 20
-    rng = np.random.RandomState(0)
-    feats = [rng.randn(int(n), 13).astype(np.float32) * 0.1
-             for n in lengths]
-    labels = rng.randint(1, 29, (n_records, L)).astype(np.int32)
-    lab_mask = np.ones((n_records, L), np.float32)
+    B, lengths, feats, labels, lab_mask, edges, new_batches = \
+        _ds2_ragged_workload(args, n_max)
+    n_records = len(lengths)
 
-    # quantile-derived pinned bucket edges (the jit cache warms once per
-    # edge); last edge = the max so nothing truncates
-    qs = np.quantile(lengths, np.linspace(1.0 / args.ds2_buckets, 1.0,
-                                          args.ds2_buckets))
-    edges = sorted(set(int(np.ceil(q)) for q in qs) | {int(lengths.max())})
-
-    # old discipline: stream order, everything padded to n_max
+    # old discipline: stream order, everything padded to n_max; the
+    # fastpath side is the shared workload's REAL BucketBatcher
+    # assembly at its production default drop_remainder=True
+    # (partially-filled buckets at end of stream are dropped and
+    # counted — a thin partial batch costs nearly a full batch's wall
+    # time, and the training pipeline's uniform-path Batcher drops
+    # remainders too)
     old_batches = []
     for s in range(0, n_records, B):
         x = np.zeros((B, n_max, 13), np.float32)
@@ -509,34 +576,23 @@ def bench_ds2_ragged(args, mesh):
                             "label_mask": lab_mask[s:s + B]})
     old_eff = padding_efficiency(lengths, n_max)
 
-    # fastpath discipline: the REAL BucketBatcher over the same stream,
-    # at its production default drop_remainder=True (partially-filled
-    # buckets at end of stream are dropped and counted — on a CPU/TPU a
-    # thin partial batch costs nearly a full batch's wall time, and the
-    # training pipeline's uniform-path Batcher drops remainders too)
-    def sample_stream():
-        for i in range(n_records):
-            yield {"input": feats[i], "n_frames": np.int32(lengths[i]),
-                   "labels": labels[i], "label_mask": lab_mask[i]}
-
-    batcher = BucketBatcher(B, edges)
-    new_batches = []
-    new_padded = new_valid = 0
-    for b in batcher.apply_iter(sample_stream()):
-        x, n = b["input"], b["n_frames"]
-        new_batches.append({"input": (x, n), "n_frames": n,
-                            "labels": b["labels"],
-                            "label_mask": b["label_mask"]})
-        new_padded += x.shape[0] * x.shape[1]
-        new_valid += int(n.sum())
+    new_padded = sum(b["input"][0].shape[0] * b["input"][0].shape[1]
+                     for b in new_batches)
+    new_valid = sum(int(b["n_frames"].sum()) for b in new_batches)
     new_eff = new_valid / max(new_padded, 1)
     new_records = sum(b["n_frames"].shape[0] for b in new_batches)
     dropped = n_records - new_records
 
     kind = jax.devices()[0].device_kind
     peak = PEAK_TFLOPS.get(kind)
+    # blended-MFU estimate basis: the device's own advertised peak when
+    # known, else the v5e reference peak docs/MFU_CEILING.md reasons in
+    # (CPU backend has no meaningful peak — the estimate then answers
+    # "what MFU would this record rate be on a v5e", clearly labeled)
+    mfu_peak = peak or PEAK_TFLOPS["TPU v5e"]
+    mfu_basis = "device_peak" if peak else "v5e_reference_197"
     n_chips = max(jax.device_count(), 1)
-    reps = max(1, max(4, args.steps // 3) // n_batches)
+    reps = max(1, max(4, args.steps // 3) // max(len(old_batches), 1))
     criterion = ds2_ctc_criterion()
     last = None
     for hidden in (args.ds2_hidden, 1760) if not args.quick \
@@ -558,6 +614,7 @@ def bench_ds2_ragged(args, mesh):
             return [mesh_lib.shard_batch(b, mesh) for b in batches]
 
         sides = {}
+        side_fpr = {}                       # FLOPs per processed record
         for name, hoist, host_batches in (
                 ("old", False, old_batches),
                 ("fastpath", True, new_batches)):
@@ -567,6 +624,7 @@ def bench_ds2_ragged(args, mesh):
                 state, m = step(state, b, 1.0)
             float(np.asarray(m["loss"]))       # readback-fenced warmup
             recs = sum(_b["labels"].shape[0] for _b in host_batches)
+            side_fpr[name] = _flops_per_record(step, state, dev, recs)
             hold = {"state": state}            # step donates its input
             #                                    state; thread it across
             #                                    windows, never reuse it
@@ -588,6 +646,10 @@ def bench_ds2_ragged(args, mesh):
 
         o_rates, f_rates, ratios = _interleaved_ab(sides["old"],
                                                    sides["fastpath"])
+
+        def mfu_of(rate, name):
+            return rate * side_fpr[name] / (mfu_peak * 1e12)
+
         extra = {}
         if peak:
             extra["peak_tflops"] = peak
@@ -597,9 +659,15 @@ def bench_ds2_ragged(args, mesh):
               utterance_seconds=sec, padding_efficiency=round(old_eff, 4),
               records=n_records,
               windows=[round(r, 3) for r in o_rates],
+              mfu_est=round(mfu_of(_median(o_rates), "old"), 5),
+              mfu_est_windows=[round(mfu_of(r, "old"), 5)
+                               for r in o_rates],
+              flops_per_record_gflop=round(side_fpr["old"] / 1e9, 3),
+              mfu_basis=mfu_basis,
               note="legacy per-step scan, all records padded to the max "
                    "length (previous pipeline discipline); device-"
-                   "resident pre-featurized batches")
+                   "resident pre-featurized batches; mfu_est = rate x "
+                   "XLA-counted FLOPs/record / peak (basis recorded)")
         last = _emit(
             f"ds2_ragged_h{hidden}_fastpath_records_per_sec_per_chip",
             _median(f_rates), "records/sec/chip",
@@ -611,6 +679,11 @@ def bench_ds2_ragged(args, mesh):
             windows=[round(r, 3) for r in f_rates],
             old_windows=[round(r, 3) for r in o_rates],
             ratio_windows=[round(r, 3) for r in ratios],
+            mfu_est=round(mfu_of(_median(f_rates), "fastpath"), 5),
+            mfu_est_windows=[round(mfu_of(r, "fastpath"), 5)
+                             for r in f_rates],
+            flops_per_record_gflop=round(side_fpr["fastpath"] / 1e9, 3),
+            mfu_basis=mfu_basis,
             device_kind=kind, **extra,
             note="hoisted+blocked scan, quantile length buckets "
                  "(production drop_remainder=True; dropped records "
@@ -618,7 +691,161 @@ def bench_ds2_ragged(args, mesh):
                  "masked BiRNN + masked CTC; vs_baseline = median "
                  "per-pair fastpath/old records-per-sec ratio, "
                  "interleaved windows, equal geometry, same seeded "
-                 "length distribution")
+                 "length distribution; mfu_est = rate x XLA-counted "
+                 "FLOPs/record / peak (the blended estimate "
+                 "docs/MFU_CEILING.md reasons in; basis recorded)")
+    return last
+
+
+def bench_ds2_persistent(args, mesh):
+    """Persistent-RNN kernel A/B (ISSUE 6): ``rnn_engine='blocked'`` vs
+    ``rnn_engine='pallas'`` at EQUAL geometry — same seeded ragged
+    length distribution, same quantile buckets, same n_frames masking
+    and masked CTC on both sides; the ONLY variable is the recurrence
+    engine.  Interleaved drift-cancelling windows with per-window
+    values, plus the achieved-intensity readout: the h2h term's
+    arithmetic intensity under each engine (weights re-streamed per
+    step vs loaded once per sequence) against the v5e ridge of ~240
+    FLOP/byte, and a blended mfu_est from XLA's compiled FLOP count.
+
+    On a CPU backend the pallas kernel runs interpret-mode (discharged
+    to XLA): the A/B then banks SCHEDULE parity/overhead, not the HBM
+    term — weight residency only pays on a real TPU, where the blocked
+    side's per-step weight restream is the structural ~B/240 ceiling
+    (docs/MFU_CEILING.md).  The backend is recorded on every line."""
+    import numpy as np
+    import jax
+
+    from analytics_zoo_tpu.core.rnn import Recurrent
+    from analytics_zoo_tpu.parallel import (Adam, create_train_state,
+                                            make_train_step, replicate)
+    from analytics_zoo_tpu.parallel import mesh as mesh_lib
+    from analytics_zoo_tpu.pipelines.deepspeech2 import (
+        ds2_ctc_criterion, make_ds2_model)
+    from analytics_zoo_tpu.transform.audio.featurize import (
+        WINDOW_SIZE, WINDOW_STRIDE)
+
+    sec = args.ds2_seconds
+    n_max = (16000 * sec - WINDOW_SIZE) // WINDOW_STRIDE + 1
+    B, _, _, _, _, edges, batches = _ds2_ragged_workload(args, n_max)
+    recs = sum(b["n_frames"].shape[0] for b in batches)
+
+    kind = jax.devices()[0].device_kind
+    backend = jax.default_backend()
+    peak = PEAK_TFLOPS.get(kind)
+    mfu_peak = peak or PEAK_TFLOPS["TPU v5e"]
+    mfu_basis = "device_peak" if peak else "v5e_reference_197"
+    n_chips = max(jax.device_count(), 1)
+    reps = max(1, max(4, args.steps // 3) // max(len(batches), 1))
+    criterion = ds2_ctc_criterion()
+    dt_bytes = 2 if args.compute_dtype in ("bf16", "bfloat16") else 4
+    last = None
+    for hidden in (args.ds2_hidden, 1760) if not args.quick \
+            else (args.ds2_hidden,):
+        sides, side_fpr, side_fb = {}, {}, {}
+        for engine in ("blocked", "pallas"):
+            model = make_ds2_model(hidden=hidden,
+                                   n_rnn_layers=args.ds2_layers,
+                                   utt_length=n_max,
+                                   rnn_block=args.ds2_block,
+                                   rnn_engine=engine)
+            optim = Adam(3e-4)
+            state = replicate(create_train_state(model, optim), mesh)
+            step = make_train_step(model.module, criterion, optim,
+                                   mesh=mesh,
+                                   compute_dtype=args.compute_dtype)
+            dev = [mesh_lib.shard_batch(b, mesh) for b in batches]
+            # the pallas engine warns and runs the blocked scan when the
+            # geometry cannot be VMEM-resident — record that, or the
+            # 'pallas' line could silently bank a blocked-vs-blocked
+            # A/B.  Capture ONLY around the measured step's compiles:
+            # make_ds2_model's fp32 batch-1 build trace above can warn
+            # at geometries where the actual compute-dtype step fits.
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                for b in dev:                  # compile each pinned shape
+                    state, m = step(state, b, 1.0)
+            side_fb[engine] = any("falling back" in str(w.message)
+                                  for w in caught)
+            float(np.asarray(m["loss"]))       # readback-fenced warmup
+            side_fpr[engine] = _flops_per_record(step, state, dev, recs)
+            hold = {"state": state}
+
+            def run(hold=hold, step=step, dev=dev):
+                t0 = time.perf_counter()
+                m = None
+                s = hold["state"]
+                for _ in range(reps):
+                    for b in dev:
+                        s, m = step(s, b, 1.0)
+                hold["state"] = s
+                float(np.asarray(m["loss"]))   # fence
+                return recs * reps / (time.perf_counter() - t0) / n_chips
+
+            sides[engine] = run
+
+        b_rates, p_rates, ratios = _interleaved_ab(sides["blocked"],
+                                                   sides["pallas"])
+        # achieved-intensity readout for the h2h term (analytic — the
+        # MFU_CEILING.md roofline algebra): per step per direction the
+        # recurrence does 2·B·H² FLOPs against the H² weight block the
+        # blocked scan re-reads from HBM every step and the persistent
+        # kernel reads once per sequence of T' steps.  PER-CHIP batch:
+        # each core's matmul only runs its own data-parallel shard
+        b_chip = max(B // n_chips, 1)
+        t_out = (n_max + 1) // 2
+        i_blocked = 2.0 * b_chip / dt_bytes
+        i_pallas = i_blocked * t_out
+
+        def mfu_of(rate, eng):
+            return rate * side_fpr[eng] / (mfu_peak * 1e12)
+
+        _emit(f"ds2_persistent_h{hidden}_blocked_records_per_sec_per_chip",
+              _median(b_rates), "records/sec/chip", None, batch=B,
+              hidden=hidden, layers=args.ds2_layers, backend=backend,
+              utterance_seconds=sec, bucket_edges=edges,
+              windows=[round(r, 3) for r in b_rates],
+              mfu_est=round(mfu_of(_median(b_rates), "blocked"), 5),
+              mfu_est_windows=[round(mfu_of(r, "blocked"), 5)
+                               for r in b_rates],
+              flops_per_record_gflop=round(side_fpr["blocked"] / 1e9, 3),
+              mfu_basis=mfu_basis,
+              h2h_intensity_flops_per_byte=round(i_blocked, 1),
+              note="blocked-scan engine (rnn_engine='blocked'): the h2h "
+                   "weight block re-streams from HBM every timestep — "
+                   "intensity ~2B/dtype_bytes vs the v5e ridge ~240")
+        last = _emit(
+            f"ds2_persistent_h{hidden}_pallas_records_per_sec_per_chip",
+            _median(p_rates), "records/sec/chip", _median(ratios),
+            batch=B, hidden=hidden, layers=args.ds2_layers,
+            backend=backend, utterance_seconds=sec, bucket_edges=edges,
+            records=recs, time_block=int(Recurrent.pallas_time_block),
+            windows=[round(r, 3) for r in p_rates],
+            blocked_windows=[round(r, 3) for r in b_rates],
+            ratio_windows=[round(r, 3) for r in ratios],
+            mfu_est=round(mfu_of(_median(p_rates), "pallas"), 5),
+            mfu_est_windows=[round(mfu_of(r, "pallas"), 5)
+                             for r in p_rates],
+            flops_per_record_gflop=round(side_fpr["pallas"] / 1e9, 3),
+            mfu_basis=mfu_basis,
+            h2h_intensity_flops_per_byte=round(i_pallas, 1),
+            h2h_weight_mbytes_per_direction=round(
+                hidden**2 * dt_bytes / 2**20, 2),
+            v5e_ridge_flops_per_byte=240,
+            device_kind=kind,
+            engine_fallback=side_fb["pallas"],
+            note="persistent-RNN Pallas engine (rnn_engine='pallas', "
+                 "ops.pallas_rnn): h2h weights load into VMEM once per "
+                 "sequence — intensity ~2*B*T'/dtype_bytes, decoupled "
+                 "from batch size; engine_fallback=true would mean the "
+                 "geometry could not be VMEM-resident and this side "
+                 "ACTUALLY ran the blocked scan; vs_baseline = median "
+                 "per-pair "
+                 "pallas/blocked records-per-sec ratio, interleaved "
+                 "windows, equal geometry/buckets/masking.  On a CPU "
+                 "backend the kernel runs interpret-mode (discharged "
+                 "to XLA) and the ratio banks schedule parity, not "
+                 "the HBM-residency term")
     return last
 
 
@@ -1393,10 +1620,18 @@ def main() -> int:
                         "the median is climate)")
     p.add_argument("--skip", default="",
                    help="comma list: link,nms,ds2,ds2_train,ds2_ragged,"
-                        "ssd_serve,"
+                        "ds2_persistent,ssd_serve,"
                         "ssd512_serve,frcnn_serve,frcnn_train,"
                         "ssd512_step,overlap,host_wall,ssd_train,"
                         "ssd_train_hostaug")
+    p.add_argument("--sweep-log", default=os.path.join(
+                       "bench_artifacts", "BENCH_sweeps.jsonl"),
+                   help="jsonl file every emitted line is ALSO appended "
+                        "to — exploratory sweeps accumulate under "
+                        "bench_artifacts/ instead of littering the repo "
+                        "root with per-run BENCH_rNN_*.jsonl files "
+                        "(docs/PERFORMANCE.md artifact index).  Empty "
+                        "string disables")
     p.add_argument("--no-isolate", action="store_true",
                    help="run all phases in THIS process instead of one "
                         "subprocess per phase (see note in main)")
@@ -1411,6 +1646,8 @@ def main() -> int:
                         "modes); each attempt is phase-timeout bounded")
     p.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     args = p.parse_args()
+    global _SWEEP_LOG
+    _SWEEP_LOG = args.sweep_log or None
     if args.quick:
         args.batch, args.steps, args.warmup, args.n_images = 4, 3, 1, 32
         args.ds2_hidden, args.ds2_layers, args.ds2_utts = 64, 1, 2
@@ -1422,7 +1659,8 @@ def main() -> int:
     # the link probe leads (it contextualizes every later number);
     # ssd_train stays last (the driver reads the LAST line as headline)
     ALL_PHASES = ["link", "serve_sched", "nms", "ds2", "ds2_train",
-                  "ds2_ragged", "ssd_serve", "ssd512_serve", "frcnn_serve",
+                  "ds2_ragged", "ds2_persistent", "ssd_serve",
+                  "ssd512_serve", "frcnn_serve",
                   "frcnn_train", "ssd512_step", "overlap", "host_wall",
                   "ssd_train_hostaug", "ssd_train"]
     if not args.child and not args.no_isolate:
@@ -1613,6 +1851,8 @@ def main() -> int:
             bench_ds2_train(args, mesh)
         if "ds2_ragged" not in skip:
             bench_ds2_ragged(args, mesh)
+        if "ds2_persistent" not in skip:
+            bench_ds2_persistent(args, mesh)
         if "frcnn_serve" not in skip:
             bench_frcnn_serve(args, mesh, records[:min(len(records), 64)])
         if "ssd512_serve" not in skip and not args.quick:

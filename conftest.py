@@ -22,3 +22,31 @@ import jax  # noqa: E402
 # "axon,cpu") at interpreter start, which would make every backend touch
 # dial the TPU relay.  Point jax back at local CPU for the test session.
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 run (-m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "pallas(device): Pallas kernel test.  Bare = interpret-mode "
+        "semantics, runs in tier-1 on the CPU backend; device=True = "
+        "needs a compiled Mosaic kernel — auto-skipped unless a real "
+        "TPU backend is active, which this conftest's CPU pin (line "
+        "~24) normally precludes: opt in with AZ_RUN_PALLAS_DEVICE=1 "
+        "after pointing the session at a TPU.")
+
+
+def pytest_collection_modifyitems(config, items):
+    if (os.environ.get("AZ_RUN_PALLAS_DEVICE")
+            or jax.default_backend() in ("tpu", "axon")):
+        return
+    skip = pytest.mark.skip(
+        reason="pallas(device=True): compiled-kernel variant needs a "
+               "real TPU backend (interpret-mode twin runs in tier-1)")
+    for item in items:
+        m = item.get_closest_marker("pallas")
+        if m is not None and m.kwargs.get("device", False):
+            item.add_marker(skip)

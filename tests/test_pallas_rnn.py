@@ -1,0 +1,345 @@
+"""Persistent-RNN Pallas kernel (ops.pallas_rnn) parity tests.
+
+Interpret mode on CPU pins the acceptance gate of ISSUE 6: the pallas
+engine must match the blocked scan to ≤1e-5 fwd AND grad — uniform and
+ragged/masked batches, both directions, every ported cell — plus the
+H-too-large-for-VMEM fallback (warn + blocked scan, never an error).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.core.rnn import (
+    BiRecurrent,
+    GRUCell,
+    LSTMCell,
+    Recurrent,
+    RnnCell,
+)
+from analytics_zoo_tpu.ops.pallas_rnn import (
+    RnnKernelConfig,
+    persistent_rnn,
+    persistent_vmem_bytes,
+)
+
+pytestmark = pytest.mark.pallas
+
+RNG = jax.random.PRNGKey(7)
+
+CELLS = [
+    ("rnn", lambda: RnnCell(hidden_size=6)),
+    ("rnn_identity", lambda: RnnCell(hidden_size=5, identity_input=True,
+                                     activation="clipped_relu")),
+    ("gru", lambda: GRUCell(hidden_size=6)),
+    ("lstm", lambda: LSTMCell(hidden_size=6)),
+]
+
+
+def _x_for(name, key=RNG, B=3, T=11):
+    D = 5 if name == "rnn_identity" else 4  # identity i2h: D == hidden
+    return jax.random.normal(key, (B, T, D))
+
+
+def _assert_tree_close(a, b, atol):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=atol)
+
+
+class TestEngineEquivalence:
+    # ragged/masked for every ported cell; the uniform variant only for
+    # the vanilla cells (for the gated cells it exercises a strict
+    # subset of the ragged path — dropping it keeps tier-1 wall time
+    # bounded without narrowing the acceptance gate)
+    @pytest.mark.parametrize(
+        "name,make,masked",
+        [(n, m, True) for n, m in CELLS]
+        + [(n, m, False) for n, m in CELLS[:2]],
+        ids=[f"{c[0]}-ragged" for c in CELLS]
+        + [f"{c[0]}-uniform" for c in CELLS[:2]])
+    def test_fwd_and_grad_match_blocked_scan(self, name, make, masked):
+        """The ISSUE-6 acceptance gate: ≤1e-5 fwd+grad vs the blocked
+        scan, uniform and masked ragged batches."""
+        x = _x_for(name)
+        n = jnp.array([11, 7, 3], jnp.int32) if masked else None
+        blocked = Recurrent(cell=make(), block_size=4)
+        pallas = Recurrent(cell=make(), engine="pallas")
+        v = blocked.init(RNG, x)
+        # shared parameter tree: pallas-engine init is shape-identical
+        v_p = pallas.init(RNG, x)
+        assert (jax.tree_util.tree_map(lambda a: a.shape, v)
+                == jax.tree_util.tree_map(lambda a: a.shape, v_p))
+
+        y_b = blocked.apply(v, x, n_frames=n)
+        y_p = pallas.apply(v, x, n_frames=n)
+        np.testing.assert_allclose(np.asarray(y_b), np.asarray(y_p),
+                                   atol=1e-5)
+
+        def loss(net):
+            return lambda v: jnp.sum(net.apply(v, x, n_frames=n) ** 2)
+
+        _assert_tree_close(jax.grad(loss(blocked))(v),
+                           jax.grad(loss(pallas))(v), atol=1e-5)
+
+    # vanilla covers the single-carry prefix gather, lstm the stacked
+    # (c, h) carry; gru's reverse path is structurally identical
+    @pytest.mark.parametrize("name,make",
+                             [CELLS[0], CELLS[3]],
+                             ids=[CELLS[0][0], CELLS[3][0]])
+    def test_reverse_direction_matches_blocked_scan(self, name, make):
+        """Reverse engine parity — the prefix-only backward scan
+        BiRecurrent needs (valid frames reverse in place, padding
+        untouched)."""
+        x = _x_for(name)
+        n = jnp.array([11, 7, 3], jnp.int32)
+        blocked = Recurrent(cell=make(), block_size=4, reverse=True)
+        pallas = Recurrent(cell=make(), engine="pallas", reverse=True)
+        v = blocked.init(RNG, x)
+        np.testing.assert_allclose(
+            np.asarray(blocked.apply(v, x, n_frames=n)),
+            np.asarray(pallas.apply(v, x, n_frames=n)), atol=1e-5)
+
+    def test_birecurrent_masked_matches_unpadded_references(self):
+        """End-to-end bidirectional check on the pallas engine: padded
+        ragged rows equal their own unpadded forwards (the padded-
+        reverse defect must stay fixed on the kernel path too)."""
+        x = _x_for("rnn")
+        n = np.array([11, 7, 3], np.int32)
+        bi = BiRecurrent(cell=RnnCell(hidden_size=6), merge="sum",
+                         engine="pallas")
+        v = bi.init(RNG, x)
+        y = np.asarray(bi.apply(v, x, n_frames=jnp.asarray(n)))
+        for i, ni in enumerate(n):
+            ref = np.asarray(bi.apply(v, x[i:i + 1, :ni]))
+            np.testing.assert_allclose(y[i:i + 1, :ni], ref, atol=1e-5,
+                                       err_msg=f"row {i} (n={ni})")
+            assert np.abs(y[i, ni:]).max(initial=0.0) == 0.0
+
+    def test_carry_and_return_carry_parity(self):
+        cell = RnnCell(hidden_size=4)
+        x = _x_for("rnn")
+        blocked = Recurrent(cell=cell, block_size=3)
+        pallas = Recurrent(cell=cell, engine="pallas")
+        v = blocked.init(RNG, x)
+        c0 = jnp.full((3, 4), 0.25)
+        y1, c1 = blocked.apply(v, x, carry0=c0, return_carry=True)
+        y2, c2 = pallas.apply(v, x, carry0=c0, return_carry=True)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(c1), np.asarray(c2),
+                                   atol=1e-5)
+
+    def test_lstm_tuple_carry_roundtrips(self):
+        """LSTM's (c, h) carry stacks into the kernel and unstacks back
+        to the blocked path's tuple convention."""
+        cell = LSTMCell(hidden_size=6)
+        x = _x_for("lstm")
+        blocked = Recurrent(cell=cell, block_size=3)
+        pallas = Recurrent(cell=cell, engine="pallas")
+        v = blocked.init(RNG, x)
+        _, c1 = blocked.apply(v, x, return_carry=True)
+        _, c2 = pallas.apply(v, x, return_carry=True)
+        assert isinstance(c2, tuple) and len(c2) == 2
+        _assert_tree_close(c1, c2, atol=1e-5)
+
+    @pytest.mark.parametrize("engine", [None, "pallas"],
+                             ids=["blocked", "pallas"])
+    def test_n_frames_beyond_t_clamps_instead_of_nan(self, engine):
+        """n_frames > T (e.g. a caller passing pre-conv frame counts to
+        a truncated batch) must clamp to T, not drive the reverse
+        prefix gather out of bounds (take_along_axis NaN fill)."""
+        x = _x_for("rnn")
+        net = Recurrent(cell=RnnCell(hidden_size=6), reverse=True,
+                        engine=engine, block_size=4)
+        v = net.init(RNG, x)
+        y_over = net.apply(v, x, n_frames=jnp.array([13, 7, 3]))
+        y_full = net.apply(v, x, n_frames=jnp.array([11, 7, 3]))
+        assert np.isfinite(np.asarray(y_over)).all()
+        np.testing.assert_allclose(np.asarray(y_over), np.asarray(y_full),
+                                   atol=1e-6)
+
+    def test_masked_carry_freezes_at_true_length(self):
+        cell = GRUCell(hidden_size=5)
+        x = _x_for("gru", B=2, T=11)
+        n = np.array([11, 6], np.int32)
+        net = Recurrent(cell=cell, engine="pallas")
+        v = net.init(RNG, x)
+        _, c = net.apply(v, x, n_frames=jnp.asarray(n), return_carry=True)
+        _, c_short = net.apply(v, x[1:2, :6], return_carry=True)
+        np.testing.assert_allclose(np.asarray(c[1:2]),
+                                   np.asarray(c_short), atol=1e-5)
+
+
+class TestVmemFallback:
+    def test_h_too_large_falls_back_to_blocked_with_warning(self):
+        """A geometry that cannot be VMEM-resident must WARN and run the
+        blocked scan — same numbers, never an error."""
+        x = _x_for("rnn")
+        blocked = Recurrent(cell=RnnCell(hidden_size=6), block_size=4)
+        tight = Recurrent(cell=RnnCell(hidden_size=6), engine="pallas",
+                          pallas_vmem_limit=1)      # nothing fits
+        v = blocked.init(RNG, x)
+        with pytest.warns(UserWarning, match="falling back"):
+            y = tight.apply(v, x)
+        np.testing.assert_allclose(np.asarray(blocked.apply(v, x)),
+                                   np.asarray(y), atol=1e-6)
+
+    def test_unsupported_cell_falls_back(self):
+        import flax.linen as nn
+
+        class OddCell(nn.Module):
+            hidden_size: int = 4
+
+            def setup(self):
+                self.h2h = nn.Dense(self.hidden_size)
+                self.i2h = nn.Dense(self.hidden_size)
+
+            def project(self, x):
+                return self.i2h(x)
+
+            def recur(self, carry, pre):
+                h = jnp.tanh(pre + self.h2h(carry))
+                return h, h
+
+            def __call__(self, carry, x):
+                return self.recur(carry, self.project(x))
+
+            def initial_carry(self, batch, dtype=jnp.float32):
+                return jnp.zeros((batch, self.hidden_size), dtype)
+
+        x = jax.random.normal(RNG, (2, 7, 3))
+        net = Recurrent(cell=OddCell(), engine="pallas")
+        with pytest.warns(UserWarning, match="does not support"):
+            v = net.init(RNG, x)
+            net.apply(v, x)
+
+    def test_budget_formula_scales_with_h_and_gates(self):
+        """The docs/PERFORMANCE.md budget formula: the weight term is
+        k·H_pad²·weight_bytes — monotone in H and gate count, and the
+        DS2 parity geometry (H=1760, bf16) fits the 16 MB core."""
+        small = persistent_vmem_bytes(256, "vanilla")
+        big = persistent_vmem_bytes(2048, "vanilla")
+        assert big > small
+        assert (persistent_vmem_bytes(256, "lstm")
+                > persistent_vmem_bytes(256, "vanilla"))
+        assert persistent_vmem_bytes(1760, "vanilla", batch=32,
+                                     weight_bytes=2) < 14 * 2**20
+
+    def test_bad_engine_name_rejected(self):
+        x = _x_for("rnn")
+        net = Recurrent(cell=RnnCell(hidden_size=6), engine="warp")
+        with pytest.raises(ValueError, match="engine"):
+            net.init(RNG, x)
+
+
+class TestKernelDirect:
+    """ops.pallas_rnn API-level checks (no flax wrapper)."""
+
+    def test_matches_reference_scan_nonaligned_shapes(self):
+        """Lane/sublane/time padding is correctness-inert: B=3 (pads to
+        8), H=6 (pads to 128), T=11 (pads to the time block)."""
+        from analytics_zoo_tpu.ops.pallas_rnn import _scan_reference
+
+        rng = np.random.RandomState(0)
+        B, T, H = 3, 11, 6
+        pre = jnp.asarray(rng.randn(B, T, H).astype(np.float32) * 0.3)
+        w = jnp.asarray(rng.randn(H, H).astype(np.float32) * 0.3)
+        b = jnp.asarray(rng.randn(H).astype(np.float32) * 0.1)
+        h0 = jnp.zeros((1, B, H))
+        n = jnp.array([11, 5, 2], jnp.int32)
+        ys, cf = persistent_rnn(pre, w, b, h0, n, cell="vanilla",
+                                activation="tanh", interpret=True)
+        cfg = RnnKernelConfig("vanilla", "tanh", 8, True)
+        ys_ref, cf_ref = _scan_reference(cfg, pre, w, b, h0, n)
+        np.testing.assert_allclose(np.asarray(ys), np.asarray(ys_ref),
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(cf), np.asarray(cf_ref),
+                                   atol=1e-6)
+
+    def test_unknown_cell_kind_raises(self):
+        pre = jnp.zeros((2, 4, 4))
+        with pytest.raises(ValueError, match="cell"):
+            persistent_rnn(pre, jnp.zeros((4, 4)), jnp.zeros((4,)),
+                           jnp.zeros((1, 2, 4)), cell="elman")
+
+    @pytest.mark.pallas(device=True)
+    def test_compiled_kernel_matches_interpret(self):
+        """Compiled-Mosaic twin of the parity test — auto-skipped off
+        TPU by the conftest `pallas` marker hook."""
+        rng = np.random.RandomState(1)
+        B, T, H = 8, 32, 128
+        pre = jnp.asarray(rng.randn(B, T, H).astype(np.float32) * 0.3)
+        w = jnp.asarray(rng.randn(H, H).astype(np.float32) * 0.3)
+        b = jnp.asarray(rng.randn(H).astype(np.float32) * 0.1)
+        h0 = jnp.zeros((1, B, H))
+        ys_c, cf_c = persistent_rnn(pre, w, b, h0, cell="vanilla",
+                                    activation="relu", interpret=False)
+        ys_i, cf_i = persistent_rnn(pre, w, b, h0, cell="vanilla",
+                                    activation="relu", interpret=True)
+        np.testing.assert_allclose(np.asarray(ys_c), np.asarray(ys_i),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cf_c), np.asarray(cf_i),
+                                   atol=1e-5)
+
+
+class TestDS2Wiring:
+    def test_ds2_model_pallas_engine_matches_blocked(self):
+        """models/deepspeech2 → pipelines wiring: the full DS2 forward
+        (conv + BN + BiRNN) agrees across engines on a masked ragged
+        batch, params shared."""
+        from analytics_zoo_tpu.pipelines.deepspeech2 import make_ds2_model
+
+        blocked = make_ds2_model(hidden=16, n_rnn_layers=1, utt_length=32,
+                                 rnn_block=4)
+        pallas = make_ds2_model(hidden=16, n_rnn_layers=1, utt_length=32,
+                                rnn_engine="pallas")
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(3, 32, 13).astype(np.float32) * 0.3)
+        n = jnp.array([32, 27, 12], jnp.int32)
+        y_b = blocked.module.apply(blocked.variables, x, n)
+        y_p = pallas.module.apply(blocked.variables, x, n)
+        np.testing.assert_allclose(np.asarray(y_b), np.asarray(y_p),
+                                   atol=1e-5)
+
+    @pytest.mark.slow
+    def test_ds2_pallas_train_grads_match_blocked(self):
+        """Full CTC-loss grad parity through the DS2 model — heavier
+        assurance on top of the tier-1 engine-level grad gate
+        (TestEngineEquivalence), so it rides the slow lane."""
+        from analytics_zoo_tpu.pipelines.deepspeech2 import (
+            ds2_ctc_criterion, make_ds2_model)
+
+        blocked = make_ds2_model(hidden=16, n_rnn_layers=1, utt_length=24,
+                                 rnn_block=4)
+        pallas = make_ds2_model(hidden=16, n_rnn_layers=1, utt_length=24,
+                                rnn_engine="pallas")
+        rng = np.random.RandomState(0)
+        batch = {
+            "input": (jnp.asarray(rng.randn(2, 24, 13).astype(np.float32)),
+                      jnp.array([24, 15], jnp.int32)),
+            "n_frames": jnp.array([24, 15], jnp.int32),
+            "labels": jnp.asarray(rng.randint(1, 29, (2, 4)), jnp.int32),
+            "label_mask": jnp.ones((2, 4), jnp.float32),
+        }
+        crit = ds2_ctc_criterion()
+
+        def loss_for(model):
+            def loss(params):
+                x, n = batch["input"]
+                lp = model.module.apply(
+                    {"params": params,
+                     **{k: v for k, v in model.variables.items()
+                        if k != "params"}}, x, n)
+                return crit(lp, batch)
+            return loss
+
+        p = blocked.variables["params"]
+        l_b, g_b = jax.value_and_grad(loss_for(blocked))(p)
+        l_p, g_p = jax.value_and_grad(loss_for(pallas))(p)
+        np.testing.assert_allclose(float(l_b), float(l_p), atol=1e-5)
+        _assert_tree_close(g_b, g_p, atol=1e-4)

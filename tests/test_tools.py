@@ -302,3 +302,33 @@ class TestServeDrillHelpers:
         assert out["drill"]["accounting"]["unaccounted"] == 0
         assert (out["miss_rate"]["shedding_plus_degradation"]
                 < out["miss_rate"]["baseline_no_shedding"])
+
+
+class TestProfileMfuRnnAb:
+    def test_rnn_ab_smoke_writes_h2h_share_artifact(self, tmp_path):
+        """Satellite (ISSUE 6): `tools/profile_mfu.py --rnn-ab` — the
+        blocked-vs-pallas engine probe runs in-process at a tiny
+        geometry and writes the h2h-share artifact (the committed
+        MFU_RNN_AB.json is the DS2-parity-geometry execution)."""
+        import json
+
+        from tools import profile_mfu
+
+        out = str(tmp_path / "MFU_RNN_AB.json")
+        rc = profile_mfu.main(["--rnn-ab", "--rnn-hidden", "16",
+                               "--rnn-batch", "2", "--rnn-frames", "8",
+                               "--iters", "1", "--out", out])
+        assert rc == 0
+        report = json.load(open(out))
+        assert set(report["engines"]) == {"blocked", "pallas"}
+        for eng in report["engines"].values():
+            assert eng["fwd_ms"] > 0 and eng["fwd_bwd_ms"] > 0
+            assert eng["engine_fallback"] is False   # CPU interpret
+        h2h = report["h2h"]
+        # the roofline algebra the ceiling doc reasons in: persistent
+        # intensity = blocked intensity x T (weights read once per
+        # sequence instead of once per step)
+        assert (h2h["intensity_persistent_flops_per_byte"]
+                == pytest.approx(
+                    h2h["intensity_blocked_flops_per_byte"] * 8))
+        assert h2h["v5e_ridge_flops_per_byte"] == 240
