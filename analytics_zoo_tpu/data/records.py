@@ -45,6 +45,15 @@ class ReadStats:
     skipped_shards: int = 0    # whole shards dropped (retry exhaustion /
     #                            truncation with skip_errors=True)
 
+    def publish(self, registry, prefix: str = "data/read") -> None:
+        """Mirror the counters into a central ``obs.MetricRegistry`` as
+        ``<prefix>/records`` etc.  Gauges (set, not inc) — the dataclass
+        is the source of truth and ``publish`` may be called repeatedly
+        (e.g. once per epoch) without double counting."""
+        for field in dataclasses.fields(self):
+            registry.gauge(f"{prefix}/{field.name}").set(
+                getattr(self, field.name))
+
 
 # ---------------------------------------------------------------------------
 # Raw container

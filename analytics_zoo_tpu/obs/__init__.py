@@ -1,0 +1,105 @@
+"""Unified telemetry spine: spans, metrics, flight recorder, exporters.
+
+Before PR 7 the repo had five generations of ad-hoc telemetry —
+``utils/profiling.StepTimer``, ``serving/metrics.ServingMetrics``,
+``data/records.ReadStats``, the PR-3 health-word decodes, and per-drill
+JSON dumps — with no shared substrate.  This package is that substrate
+(Clockwork's bottom-up action logs and Clipper's per-decision
+instrumentation are the pattern sources):
+
+- :mod:`span` — :class:`Span`/:class:`Tracer`: trace-ids threaded
+  end-to-end (loader epoch/batch → train step → checkpoint; serving
+  submit → queue → batch → dispatch → response) plus the
+  :func:`span_conservation` structural check;
+- :mod:`registry` — :class:`MetricRegistry`: counters, gauges,
+  bounded-reservoir histograms, one snapshot schema;
+- :mod:`recorder` — :class:`FlightRecorder`: bounded ring buffer,
+  deterministic JSONL black-box dump on terminal conditions;
+- :mod:`exporters` — JSONL dump, Prometheus text rendering,
+  :class:`SummaryBridge` into the TensorBoard writers;
+- :mod:`probe` — :class:`StepProbe`: the dispatch / device /
+  input-wait step decomposition as a reusable API;
+- :mod:`runmeta` — :func:`run_metadata`: the artifact-stamping block
+  ``tools/check_artifacts.py`` lints for.
+
+Everything runs on the injected clock (``utils.clock``), so drills on a
+``VirtualClock`` produce byte-identical traces from a seed
+(``OBS_r01.json`` pins the sha256), and the layer's hot-path cost is
+banked, not assumed (``bench.py obs_overhead``).  Docs:
+``docs/OBSERVABILITY.md``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from analytics_zoo_tpu.obs.exporters import (SummaryBridge,
+                                             dump_flight_jsonl,
+                                             render_prometheus)
+from analytics_zoo_tpu.obs.probe import StepProbe
+from analytics_zoo_tpu.obs.recorder import DEFAULT_CAPACITY, FlightRecorder
+from analytics_zoo_tpu.obs.registry import (Counter, Gauge, MetricRegistry,
+                                            ReservoirHistogram)
+from analytics_zoo_tpu.obs.runmeta import run_metadata
+from analytics_zoo_tpu.obs.span import Span, Tracer, span_conservation
+from analytics_zoo_tpu.utils.clock import TimeSource
+
+
+class Observability:
+    """The convenience bundle most call sites take: one clock, one
+    registry, one flight recorder, one tracer, wired together.
+
+    ``dump_path`` arms the black box: terminal conditions
+    (``TrainingDiverged``, replica fences, drill completion) call
+    :meth:`dump` and the ring lands there as JSONL.  Subsystems that
+    own a clock (the serving runtime) call :meth:`adopt_clock` so the
+    whole bundle follows their time source unless one was injected
+    explicitly."""
+
+    def __init__(self, clock: TimeSource = None,
+                 capacity: int = DEFAULT_CAPACITY,
+                 registry: Optional[MetricRegistry] = None,
+                 dump_path: Optional[str] = None,
+                 seed: int = 0):
+        self._clock_pinned = clock is not None
+        self.registry = registry if registry is not None \
+            else MetricRegistry(seed=seed)
+        self.recorder = FlightRecorder(capacity=capacity, clock=clock,
+                                       dump_path=dump_path)
+        self.tracer = Tracer(clock=clock, recorder=self.recorder)
+
+    @property
+    def dump_path(self) -> Optional[str]:
+        return self.recorder.dump_path
+
+    def adopt_clock(self, clock: TimeSource) -> None:
+        """Follow ``clock`` unless one was injected at construction."""
+        if self._clock_pinned or clock is None:
+            return
+        from analytics_zoo_tpu.utils.clock import as_now_fn
+
+        now = as_now_fn(clock)
+        self.recorder.now = now
+        self.tracer.now = now
+
+    def dump(self, reason: str, path: Optional[str] = None) -> str:
+        return self.recorder.dump(reason, path=path)
+
+
+__all__ = [
+    "Counter",
+    "DEFAULT_CAPACITY",
+    "FlightRecorder",
+    "Gauge",
+    "MetricRegistry",
+    "Observability",
+    "ReservoirHistogram",
+    "Span",
+    "StepProbe",
+    "SummaryBridge",
+    "Tracer",
+    "dump_flight_jsonl",
+    "render_prometheus",
+    "run_metadata",
+    "span_conservation",
+]

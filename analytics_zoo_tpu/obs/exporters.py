@@ -1,0 +1,117 @@
+"""Exporters: one registry/recorder, three output surfaces.
+
+- :func:`dump_flight_jsonl` — the black-box JSONL file (the recorder's
+  own ``dump`` with an explicit path);
+- :func:`render_prometheus` — Prometheus text exposition of a
+  :class:`~analytics_zoo_tpu.obs.registry.MetricRegistry` snapshot (what
+  a scrape endpoint would serve; drills bank it as a string so the
+  format itself is pinned by tests);
+- :class:`SummaryBridge` — pushes registry values into the existing
+  ``parallel/summary.py`` TensorBoard writers, so training metrics land
+  next to the Loss/LearningRate curves the Optimizer already writes,
+  reusing the per-tag ``Trigger`` gating.
+
+Name convention: trailing ``k=v`` path segments become Prometheus
+labels — ``serve/latency_s/tier=0`` renders as
+``serve_latency_s{tier="0"}``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Tuple
+
+from analytics_zoo_tpu.obs.recorder import FlightRecorder
+from analytics_zoo_tpu.obs.registry import MetricRegistry
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def dump_flight_jsonl(recorder: FlightRecorder, path: str,
+                      reason: str = "export") -> str:
+    """Write the recorder ring to ``path`` as JSONL; returns the text."""
+    return recorder.dump(reason, path=path)
+
+
+def _prom_name(name: str) -> Tuple[str, str]:
+    """Split a registry name into (prometheus_name, label_block)."""
+    parts = name.split("/")
+    labels = []
+    while parts and "=" in parts[-1]:
+        k, v = parts.pop().split("=", 1)
+        labels.append((_NAME_RE.sub("_", k), v.replace('"', "'")))
+    base = _NAME_RE.sub("_", "_".join(parts)) or "metric"
+    if base[0].isdigit():
+        base = "_" + base
+    block = ("{" + ",".join(f'{k}="{v}"' for k, v in sorted(labels)) + "}"
+             if labels else "")
+    return base, block
+
+
+def render_prometheus(registry: MetricRegistry) -> str:
+    """Prometheus text format: counters and gauges as single samples,
+    histograms as ``_count``/``_sum`` plus p50/p99 quantile gauges
+    (reservoir summaries, not cumulative buckets — the registry keeps a
+    sample, not a bucket vector).  Registry names differing only in
+    their trailing ``k=v`` segments are one metric FAMILY: the format
+    requires exactly one ``# TYPE`` line per family with all labeled
+    samples contiguous under it, so metrics are grouped by family
+    first."""
+    def fmt(v) -> str:
+        if v is None:
+            return "NaN"
+        return repr(float(v))
+
+    # family (base, kind) -> sample lines, first-seen order (registry
+    # iteration is name-sorted, so label variants arrive together)
+    families: "dict[tuple, List[str]]" = {}
+    for name, m in registry.metrics().items():
+        base, labels = _prom_name(name)
+        fam = families.setdefault((base, m.kind), [])
+        if m.kind == "counter":
+            fam.append(f"{base}_total{labels} {m.value}")
+        elif m.kind == "gauge":
+            fam.append(f"{base}{labels} {fmt(m.value)}")
+        else:
+            snap = m.snapshot()
+            inner = labels[1:-1] if labels else ""
+            for q, key in (("0.5", "p50"), ("0.99", "p99")):
+                lab = "{" + (inner + "," if inner else "") + \
+                    f'quantile="{q}"' + "}"
+                fam.append(f"{base}{lab} {fmt(snap[key])}")
+            fam.append(f"{base}_sum{labels} {fmt(snap['sum'])}")
+            fam.append(f"{base}_count{labels} {snap['count']}")
+    lines: List[str] = []
+    for (base, kind), fam in families.items():
+        # the counter family's exposition name is the _total series
+        tname = base + "_total" if kind == "counter" else base
+        ttype = "summary" if kind == "histogram" else kind
+        lines.append(f"# TYPE {tname} {ttype}")
+        lines.extend(fam)
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+class SummaryBridge:
+    """Feed a registry snapshot into a ``parallel.summary`` writer.
+
+    ``export(registry, iteration)`` writes every counter/gauge as a
+    scalar and every histogram's mean/p99 — tags are the registry names
+    (slashes kept: TensorBoard groups on them).  Trigger gating is the
+    summary's own (``set_summary_trigger`` per tag), so high-frequency
+    export calls stay cheap for gated-off tags."""
+
+    def __init__(self, summary):
+        self.summary = summary
+
+    def export(self, registry: MetricRegistry, iteration: int) -> None:
+        for name, m in registry.metrics().items():
+            if m.kind in ("counter", "gauge"):
+                if m.value is not None:
+                    self.summary.add_scalar(name, m.value, iteration)
+            else:
+                snap = m.snapshot()
+                if snap["count"]:
+                    self.summary.add_scalar(f"{name}/mean", snap["mean"],
+                                            iteration)
+                    self.summary.add_scalar(f"{name}/p99", snap["p99"],
+                                            iteration)

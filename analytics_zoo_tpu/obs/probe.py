@@ -1,0 +1,101 @@
+"""Host/device step decomposition probe.
+
+The SERVE_PROFILE / ``host_wall`` methodology promoted into a reusable
+API: a step's host wall time splits into
+
+- **input wait** — blocking on the data pipeline (``next(iterator)``);
+- **dispatch** — the Python/jax call until the step function RETURNS
+  (async dispatch: tracing/lowering on first call, argument transfer
+  staging, program launch);
+- **device** — from dispatch return until ``block_until_ready`` on the
+  result (actual accelerator execution the host then waits out).
+
+``host_bound_fraction = (input_wait + dispatch) / total`` is the number
+the PR-2 loader work moved (0.826 → 0.545); this probe turns the
+one-off bench arithmetic into something any loop can wear.  The fence
+(``block_until_ready``) is part of the measurement by design — the
+probe answers "where does the wall time go", not "what is peak
+overlapped throughput"; an overlapped pipeline should probe a WINDOW of
+steps, not each one.
+
+Usage::
+
+    probe = StepProbe(registry=reg)          # registry optional
+    for _ in range(steps):
+        with probe.input_wait():
+            batch = next(it)
+        out = probe.step(step_fn, state, batch)   # fenced
+    probe.summary()
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Callable, Dict, Optional
+
+from analytics_zoo_tpu.obs.registry import MetricRegistry
+
+
+class StepProbe:
+    """Accumulates the three-way decomposition over a run of steps.
+
+    ``registry`` (optional): observations are mirrored into
+    ``<prefix>/input_wait_s`` / ``<prefix>/dispatch_s`` /
+    ``<prefix>/device_s`` reservoir histograms.  The probe uses real
+    ``perf_counter`` time on purpose — it measures the actual host,
+    not a virtual schedule."""
+
+    def __init__(self, registry: Optional[MetricRegistry] = None,
+                 prefix: str = "probe"):
+        self.registry = registry
+        self.prefix = prefix
+        self.steps = 0
+        self.input_wait_s = 0.0
+        self.dispatch_s = 0.0
+        self.device_s = 0.0
+
+    def _observe(self, metric: str, v: float) -> None:
+        if self.registry is not None:
+            self.registry.histogram(f"{self.prefix}/{metric}").observe(v)
+
+    @contextlib.contextmanager
+    def input_wait(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.input_wait_s += dt
+            self._observe("input_wait_s", dt)
+
+    def step(self, step_fn: Callable, *args: Any, **kwargs: Any) -> Any:
+        """Run one step: time the dispatch, then fence the result and
+        time the device wait.  Returns the (ready) step output."""
+        import jax
+
+        t0 = time.perf_counter()
+        out = step_fn(*args, **kwargs)
+        t1 = time.perf_counter()
+        jax.block_until_ready(out)
+        t2 = time.perf_counter()
+        self.steps += 1
+        self.dispatch_s += t1 - t0
+        self.device_s += t2 - t1
+        self._observe("dispatch_s", t1 - t0)
+        self._observe("device_s", t2 - t1)
+        return out
+
+    def summary(self) -> Dict[str, Any]:
+        total = self.input_wait_s + self.dispatch_s + self.device_s
+        host = self.input_wait_s + self.dispatch_s
+        return {
+            "steps": self.steps,
+            "input_wait_s": round(self.input_wait_s, 6),
+            "dispatch_s": round(self.dispatch_s, 6),
+            "device_s": round(self.device_s, 6),
+            "total_s": round(total, 6),
+            "host_bound_fraction": round(host / total, 4) if total else None,
+            "input_wait_fraction": (round(self.input_wait_s / total, 4)
+                                    if total else None),
+        }

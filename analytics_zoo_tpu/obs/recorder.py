@@ -1,0 +1,100 @@
+"""Bounded ring-buffer flight recorder with a deterministic JSONL dump.
+
+The black box: every finished span and every point event lands in a
+fixed-capacity ring (oldest entries overwritten, never unbounded
+growth), and on a terminal condition — ``TrainingDiverged``, a replica
+fence, drill completion — the ring is dumped as deterministic JSONL so
+the last N seconds of system behavior survive the crash.  Clockwork's
+per-request action logs and the PR-3 forensics bundles are the pattern:
+the evidence must already be in memory WHEN the failure happens; you
+cannot start recording after the fact.
+
+Determinism contract: events are serialized with sorted keys and a
+monotonically increasing ``seq``; all timestamps come from the injected
+clock.  Under a :class:`~analytics_zoo_tpu.utils.clock.VirtualClock`
+two runs from the same seed produce byte-identical dumps —
+``OBS_r01.json`` pins the sha256.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional
+
+from analytics_zoo_tpu.utils.clock import TimeSource, as_now_fn
+
+DEFAULT_CAPACITY = 8192
+
+
+class FlightRecorder:
+    """Fixed-capacity event ring.
+
+    ``record`` appends a dict (a ``seq`` is stamped; the caller supplies
+    ``kind`` and, conventionally, ``t``).  ``note`` is the point-event
+    convenience (stamps ``t`` from the recorder clock).  ``dump``
+    serializes the live ring to JSONL, optionally to ``dump_path`` —
+    callers wire it to their terminal conditions."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 clock: TimeSource = None,
+                 dump_path: Optional[str] = None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.now = as_now_fn(clock)
+        self.dump_path = dump_path
+        self.dropped = 0          # events overwritten by the ring bound
+        self.dumps: List[Dict[str, Any]] = []   # (reason, path) log
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._seq = 0
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    # -- feed ----------------------------------------------------------------
+    def record(self, event: Dict[str, Any]) -> None:
+        if len(self._ring) == self.capacity:
+            self.dropped += 1
+        event = dict(event)
+        event["seq"] = self._seq
+        self._seq += 1
+        self._ring.append(event)
+
+    def note(self, kind: str, **fields: Any) -> None:
+        """Record one point event (``kind`` + fields, ``t`` stamped from
+        the recorder clock unless the caller provided one)."""
+        fields.setdefault("t", round(self.now(), 6))
+        fields["kind"] = kind
+        self.record(fields)
+
+    # -- read ----------------------------------------------------------------
+    def events(self, kind: Optional[str] = None) -> List[Dict[str, Any]]:
+        evs: Iterable[Dict[str, Any]] = self._ring
+        if kind is not None:
+            evs = (e for e in evs if e.get("kind") == kind)
+        return list(evs)
+
+    def to_jsonl(self) -> str:
+        """The ring as JSONL text: one sorted-keys JSON object per line,
+        in seq order (the deque is already oldest→newest)."""
+        return "".join(json.dumps(e, sort_keys=True) + "\n"
+                       for e in self._ring)
+
+    def dump(self, reason: str, path: Optional[str] = None) -> str:
+        """Serialize the ring; write to ``path`` (or the configured
+        ``dump_path``) when one is set.  Returns the JSONL text either
+        way.  Every dump is logged in ``dumps`` so drills can assert
+        WHICH terminal condition tripped the black box."""
+        text = self.to_jsonl()
+        target = path or self.dump_path
+        if target:
+            os.makedirs(os.path.dirname(os.path.abspath(target)),
+                        exist_ok=True)
+            with open(target, "w") as f:
+                f.write(text)
+        self.dumps.append({"reason": reason, "path": target,
+                           "events": len(self._ring),
+                           "dropped": self.dropped})
+        return text

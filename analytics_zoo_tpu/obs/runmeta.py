@@ -1,0 +1,59 @@
+"""Run metadata stamping for committed artifacts.
+
+Every drill/bench artifact the repo banks (``RESILIENCE_r0*.json``,
+``OBS_*.json``, …) must be traceable to the code, seed, and environment
+that produced it — the r0* files predating this helper cannot be tied
+to a commit, which is exactly the gap ``tools/check_artifacts.py``
+lints against.  One shared helper so every tool stamps the SAME block::
+
+    report["run_metadata"] = run_metadata("serve_drill", seed=args.seed)
+
+Note the sha is HEAD at generation time — for a committed artifact that
+is the PARENT of the commit adding it (the artifact cannot contain its
+own hash).  ``git_dirty`` records whether the working tree had
+uncommitted changes beyond the artifact itself.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import subprocess
+from typing import Any, Dict, Optional
+
+#: keys every stamped artifact must carry (the check_artifacts lint)
+REQUIRED_KEYS = ("tool", "seed", "git_sha", "backend", "jax_version")
+
+
+def _git(args, cwd: str) -> Optional[str]:
+    try:
+        out = subprocess.run(["git"] + args, cwd=cwd, capture_output=True,
+                             text=True, timeout=10)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return out.stdout.strip() if out.returncode == 0 else None
+
+
+def run_metadata(tool: str, seed: Optional[int] = None,
+                 extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """The shared metadata block: tool name, seed, git sha/dirty flag,
+    jax backend + version, python version.  ``extra`` merges on top
+    (e.g. ``{"smoke": True}``).  Never raises — outside a git checkout
+    the sha fields degrade to ``None``."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    sha = _git(["rev-parse", "HEAD"], root)
+    status = _git(["status", "--porcelain"], root)
+    import jax
+
+    meta: Dict[str, Any] = {
+        "tool": tool,
+        "seed": seed,
+        "git_sha": sha,
+        "git_dirty": bool(status) if status is not None else None,
+        "backend": jax.default_backend(),
+        "jax_version": jax.__version__,
+        "python": platform.python_version(),
+    }
+    meta.update(extra or {})
+    return meta

@@ -503,6 +503,7 @@ class Optimizer:
         self._iter_in_epoch = 0
         self.anomaly_policy = None
         self._anomaly = None        # AnomalySentinel, built per optimize()
+        self.obs = None             # obs.Observability (set_observability)
 
     # -- fluent config (reference API names, snake_cased) ------------------
     def set_optim_method(self, m: OptimMethod) -> "Optimizer":
@@ -569,6 +570,32 @@ class Optimizer:
         round trip per step (health word + loss fetched together)."""
         from analytics_zoo_tpu.resilience.anomaly import AnomalyPolicy
         self.anomaly_policy = policy or AnomalyPolicy()
+        return self
+
+    def set_observability(self, obs=None) -> "Optimizer":
+        """Arm the telemetry spine (:class:`analytics_zoo_tpu.obs.
+        Observability`): per-step spans at their loader coordinates
+        (trace id ``train-e<epoch>-b<batch>``), checkpoint save/restore
+        spans, ``train/dispatch/*`` metrics via
+        :class:`~analytics_zoo_tpu.utils.profiling.StepTimer`, and
+        anomaly-ladder counters — all in the shared registry/flight
+        recorder.  On ``TrainingDiverged`` (ladder OR failure detector)
+        the recorder dumps its ring (the black box) to ``obs.dump_path``
+        when one is configured.
+
+        Timing semantics: the step span and ``train/dispatch/step_s``
+        cover the HOST interval of the train-step call — jax dispatch
+        is asynchronous, so without a per-step sync this is dispatch
+        latency, not device wall time (with the anomaly sentinel armed
+        its per-step health fetch makes it ≈wall).  A deliberate
+        choice: fencing every step to measure it would serialize the
+        pipeline the PR-2 work overlapped.  For the fenced
+        dispatch/device/input-wait decomposition use
+        :class:`analytics_zoo_tpu.obs.StepProbe` on a probe run.
+        Cost is banked by ``bench.py obs_overhead`` (≤ 3 % per step);
+        ``None`` builds a default bundle."""
+        from analytics_zoo_tpu.obs import Observability
+        self.obs = obs or Observability()
         return self
 
     def set_resume(self, path: Optional[str] = None) -> "Optimizer":
@@ -648,6 +675,16 @@ class Optimizer:
                     self._promote_lkg(loop, state)
         eval_step = make_eval_step(self.model.module,
                                    compute_dtype=self.compute_dtype)
+        # telemetry spine: the tracer/StepTimer pair is None-checked on
+        # the hot path so an un-instrumented loop pays nothing
+        obs = self.obs
+        tracer = obs.tracer if obs is not None else None
+        step_timer = None
+        if obs is not None:
+            from analytics_zoo_tpu.utils.profiling import StepTimer
+            # "dispatch" named honestly: async dispatch returns before
+            # the device finishes (see set_observability docstring)
+            step_timer = StepTimer("train/dispatch", registry=obs.registry)
         if self.prefetch:
             from analytics_zoo_tpu.data.prefetch import device_prefetch
         ph = self.preemption_handler
@@ -688,8 +725,39 @@ class Optimizer:
                                          batch, self.mesh,
                                          overrides=self.batch_overrides))
                         # device_transform is fused INSIDE train_step
-                        state, metrics = train_step(state, dev_batch,
-                                                    self.optim.lr_scale)
+                        step_span = None
+                        if tracer is not None:
+                            # loader coordinates ARE the trace identity:
+                            # the same (epoch, batch) replays as the
+                            # same trace under the PR-2 determinism
+                            # contract
+                            step_span = tracer.start(
+                                "train_step",
+                                f"train-e{loop.epoch}"
+                                f"-b{self._iter_in_epoch}",
+                                iteration=loop.iteration + 1,
+                                epoch=loop.epoch,
+                                batch=self._iter_in_epoch)
+                        try:
+                            if step_timer is None:
+                                state, metrics = train_step(
+                                    state, dev_batch, self.optim.lr_scale)
+                            else:
+                                with step_timer.step(n):
+                                    state, metrics = train_step(
+                                        state, dev_batch,
+                                        self.optim.lr_scale)
+                        except BaseException as e:
+                            # an exception escaping the step (XLA error,
+                            # watchdog interrupt) must still CLOSE the
+                            # span — spans reach the flight recorder on
+                            # end(), and the crashed step is exactly the
+                            # event the black box exists to capture
+                            if step_span is not None:
+                                step_span.end(
+                                    status="error",
+                                    error=f"{type(e).__name__}: {e}")
+                            raise
                         loop.iteration += 1
                         self._iter_in_epoch += 1
                         records += n
@@ -704,7 +772,7 @@ class Optimizer:
                             # loop.loss/health after a rollback
                             state = self._anomaly_step(
                                 loop, state, metrics, dev_batch,
-                                epoch_iter)
+                                epoch_iter, step_span=step_span)
                         elif (self.failure_detector is not None
                                 and self.failure_detector.should_check(
                                     loop.iteration)):
@@ -713,8 +781,26 @@ class Optimizer:
                             # so feeding the detector a discarded step's
                             # NaN loss would raise fatal TrainingDiverged
                             # before the ladder could roll back
-                            self.failure_detector.check(float(metrics["loss"]),
-                                                        loop.iteration)
+                            try:
+                                self.failure_detector.check(
+                                    float(metrics["loss"]), loop.iteration)
+                            except Exception as e:
+                                # same black-box contract as the ladder
+                                # path: a diverged run dumps the ring
+                                # before propagating
+                                if (step_span is not None
+                                        and not step_span.ended):
+                                    step_span.end(
+                                        status="error",
+                                        error=f"{type(e).__name__}: {e}")
+                                if obs is not None:
+                                    obs.recorder.note(
+                                        "training_diverged",
+                                        iteration=loop.iteration)
+                                    obs.dump("training_diverged")
+                                raise
+                        if step_span is not None and not step_span.ended:
+                            step_span.end(status="ok")
                         if self.train_summary is not None:
                             # device arrays on purpose: add_scalar floats them
                             # only when the tag's trigger fires
@@ -881,14 +967,20 @@ class Optimizer:
 
     # -- anomaly sentinel (resilience.anomaly ladder) ----------------------
     def _anomaly_step(self, loop: TrainingState, state: TrainState,
-                      metrics, dev_batch, epoch_iter) -> TrainState:
+                      metrics, dev_batch, epoch_iter,
+                      step_span=None) -> TrainState:
         """Per-step ladder: feed the health word to the sentinel, write
         forensics on an episode's first bad step, roll back / escalate.
-        Returns the (possibly restored) state."""
+        Returns the (possibly restored) state.  ``step_span`` (telemetry
+        spine): closed here with the ladder's verdict so the flight
+        recorder names unhealthy steps; ladder actions also count into
+        the shared registry, and a diverged run dumps the black box
+        before raising."""
         from analytics_zoo_tpu.resilience import anomaly as anomaly_lib
         from analytics_zoo_tpu.resilience.errors import TrainingDiverged
 
         sent = self._anomaly
+        obs = self.obs
         # ONE device->host round trip for both scalars (the sentinel's
         # documented per-step host cost)
         word, loss_host = jax.device_get((metrics["health"],
@@ -897,6 +989,12 @@ class Optimizer:
         loop.health = word
         sent.record_loss(float(loss_host))
         action, first = sent.observe(word)
+        if step_span is not None:
+            step_span.end(status="ok" if word == 0 else "unhealthy",
+                          **({} if word == 0
+                             else {"health_word": word, "action": action}))
+        if obs is not None and word:
+            obs.registry.counter("train/anomaly/bad_steps").inc()
         if word:
             sent.note_skip(word, step=loop.iteration)
             logger.warning(
@@ -907,9 +1005,19 @@ class Optimizer:
         if first:
             self._write_forensics(sent, word, loop, state, dev_batch)
         if action == "rollback":
+            if obs is not None:
+                obs.registry.counter("train/anomaly/rollbacks").inc()
             state = self._anomaly_rollback(loop, state)
             self._reseek(epoch_iter, sent.policy.reseek)
         elif action == "diverged":
+            if obs is not None:
+                # terminal condition: the ring becomes the black box
+                obs.recorder.note(
+                    "training_diverged", iteration=loop.iteration,
+                    health_word=word,
+                    rollbacks=sent.rollbacks,
+                    consecutive_bad=sent.consecutive_bad)
+                obs.dump("training_diverged")
             raise TrainingDiverged(
                 f"anomaly ladder exhausted at iteration {loop.iteration}: "
                 f"{sent.consecutive_bad} consecutive unhealthy steps with "
@@ -1087,11 +1195,24 @@ class Optimizer:
         # optim state (Plateau's learned LR scale) ride in the snapshot's
         # own manifest, so a restore can never pair params with metadata
         # from a DIFFERENT snapshot.
-        ckpt.save(self.checkpoint_path, state, step=tag,
-                  keep_last=self.checkpoint_keep_last,
-                  meta={"epoch": loop.epoch, "iteration": loop.iteration,
-                        "iter_in_epoch": self._iter_in_epoch,
-                        "optim": self.optim.state_dict()})
+        import contextlib
+        # with obs armed the save is both a span (trace
+        # ckpt-i<iteration>) and a checkpoint/save_s histogram entry
+        t0 = time.perf_counter()
+        span = (self.obs.tracer.span(
+                    "checkpoint_save", f"ckpt-i{loop.iteration}",
+                    iteration=loop.iteration,
+                    tag="latest" if tag is None else f"step_{tag}")
+                if self.obs is not None else contextlib.nullcontext())
+        with span:
+            ckpt.save(self.checkpoint_path, state, step=tag,
+                      keep_last=self.checkpoint_keep_last,
+                      meta={"epoch": loop.epoch, "iteration": loop.iteration,
+                            "iter_in_epoch": self._iter_in_epoch,
+                            "optim": self.optim.state_dict()})
+        if self.obs is not None:
+            self.obs.registry.histogram("checkpoint/save_s").observe(
+                time.perf_counter() - t0)
         return True
 
     def _apply_resume_meta(self, meta, loop: TrainingState, state) -> None:
@@ -1118,7 +1239,17 @@ class Optimizer:
             snap_dir, manifest = found
             # newest_intact already checksummed this exact dir — do not
             # pay a second full read+sha256 pass on the restart hot path
-            state = ckpt.load(snap_dir, target=state, verify=False)
+            import contextlib
+            t0 = time.perf_counter()
+            span = (self.obs.tracer.span(
+                        "checkpoint_restore", "ckpt-restore",
+                        snapshot=os.path.basename(snap_dir))
+                    if self.obs is not None else contextlib.nullcontext())
+            with span:
+                state = ckpt.load(snap_dir, target=state, verify=False)
+            if self.obs is not None:
+                self.obs.registry.histogram("checkpoint/restore_s").observe(
+                    time.perf_counter() - t0)
             self._apply_resume_meta(manifest.get("meta", {}), loop, state)
         else:
             # legacy layout (pre-manifest snapshots): best-effort restore

@@ -26,10 +26,10 @@ from __future__ import annotations
 import _thread
 import logging
 import threading
-import time
 from typing import Callable, Optional
 
 from analytics_zoo_tpu.resilience.errors import StallError
+from analytics_zoo_tpu.utils.clock import as_now_fn
 
 logger = logging.getLogger("analytics_zoo_tpu")
 
@@ -60,7 +60,7 @@ class StallWatchdog:
     def __init__(self, timeout_s: float, poll_s: Optional[float] = None,
                  name: str = "train",
                  on_stall: Optional[Callable[["StallWatchdog"], None]] = None,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock=None):
         if timeout_s <= 0:
             raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
         self.timeout_s = float(timeout_s)
@@ -68,11 +68,13 @@ class StallWatchdog:
                           else min(timeout_s / 4.0, 1.0))
         self.name = name
         self.on_stall = on_stall
-        # injectable time source: the serving runtime supervises replica
-        # forwards in PULL mode (beat → check) on a virtual clock so the
-        # wedged-replica path is deterministic in tests and the drill;
-        # the threaded monitor path keeps real time by default
-        self._clock = clock if clock is not None else time.monotonic
+        # injectable time source — a utils.clock.Clock object or a bare
+        # now() callable (both normalized): the serving runtime
+        # supervises replica forwards in PULL mode (beat → check) on a
+        # virtual clock so the wedged-replica path is deterministic in
+        # tests and the drill; the threaded monitor path keeps real
+        # time by default
+        self._clock = as_now_fn(clock)
         self._last = self._clock()
         self._stalled = False
         self._stop = threading.Event()

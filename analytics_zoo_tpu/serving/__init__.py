@@ -12,7 +12,8 @@ load-shedding frontier (Crankshaw et al., NSDI'17) and Clockwork's
 predictable-latency discipline (Gujarati et al., OSDI'20):
 
 - :mod:`clock` — injected time (:class:`VirtualClock` for deterministic
-  tests/drills, :class:`MonotonicClock` for production);
+  tests/drills, :class:`MonotonicClock` for production; since PR 7 a
+  re-export of the shared :mod:`analytics_zoo_tpu.utils.clock`);
 - :mod:`request` — :class:`Request`, bounded EDF :class:`AdmissionQueue`
   with shed-before-dispatch;
 - :mod:`batcher` — :class:`DeadlineBatcher`, flush-on-full-or-urgent

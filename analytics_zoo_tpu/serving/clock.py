@@ -1,62 +1,17 @@
-"""Clock abstraction for the serving runtime.
+"""Compatibility shim: the clock moved to ``analytics_zoo_tpu.utils.clock``.
 
-Every scheduling decision in :mod:`analytics_zoo_tpu.serving` — deadline
-slack, shed-before-dispatch, replica restart timers, degradation-ladder
-windows — reads time through one injected clock object instead of
-``time.monotonic`` directly.  Production uses :class:`MonotonicClock`;
-tests and the committed drill use :class:`VirtualClock`, where time only
-moves when the harness says so: a 4× overload burst with a mid-batch
-replica crash then replays bit-identically in milliseconds of real CPU,
-which is what lets ``RESILIENCE_r03.json`` pin exact shed counts and
-tier transitions.
+The serving runtime grew the injected-clock abstraction first (PR 5);
+PR 7's telemetry spine and the :class:`~analytics_zoo_tpu.resilience.
+watchdog.StallWatchdog` need the same time source, so the classes now
+live in :mod:`analytics_zoo_tpu.utils.clock` and are re-exported here
+unchanged for existing imports (``from analytics_zoo_tpu.serving.clock
+import VirtualClock`` keeps working)."""
 
-The same clock's ``now`` is handed to each replica's
-:class:`~analytics_zoo_tpu.resilience.watchdog.StallWatchdog` (its
-``clock=`` parameter), so stall supervision follows virtual time too.
-"""
+from analytics_zoo_tpu.utils.clock import (  # noqa: F401
+    Clock,
+    MonotonicClock,
+    VirtualClock,
+    as_now_fn,
+)
 
-from __future__ import annotations
-
-import time
-
-
-class Clock:
-    """Interface: ``now()`` seconds (monotonic), ``sleep(s)``."""
-
-    def now(self) -> float:
-        raise NotImplementedError
-
-    def sleep(self, seconds: float) -> None:
-        raise NotImplementedError
-
-
-class MonotonicClock(Clock):
-    """Real wall time (``time.monotonic``)."""
-
-    def now(self) -> float:
-        return time.monotonic()
-
-    def sleep(self, seconds: float) -> None:
-        time.sleep(max(0.0, seconds))
-
-
-class VirtualClock(Clock):
-    """Deterministic manual time: ``now()`` returns the current virtual
-    instant; ``advance``/``sleep`` move it forward.  Single-threaded by
-    design — the serving runtime's scheduler is synchronous, so nothing
-    ever blocks waiting for another thread to advance the clock."""
-
-    def __init__(self, start: float = 0.0):
-        self._t = float(start)
-
-    def now(self) -> float:
-        return self._t
-
-    def advance(self, seconds: float) -> float:
-        if seconds < 0:
-            raise ValueError(f"cannot advance time backwards ({seconds})")
-        self._t += float(seconds)
-        return self._t
-
-    def sleep(self, seconds: float) -> None:
-        self.advance(seconds)
+__all__ = ["Clock", "MonotonicClock", "VirtualClock", "as_now_fn"]

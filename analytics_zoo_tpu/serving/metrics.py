@@ -5,66 +5,121 @@ timestamp comes from the runtime's injected clock, so a virtual-clock
 run produces a bit-deterministic snapshot.  Exported as one plain dict
 (:meth:`ServingMetrics.snapshot`) the drill dumps into
 ``RESILIENCE_r03.json`` and an operator would scrape.
+
+Since PR 7 the distributions live in a central
+:class:`~analytics_zoo_tpu.obs.registry.MetricRegistry` (bounded
+reservoir histograms): per-tier latency, batch fill, and queue depth
+used to be unbounded Python lists full-sorted on every snapshot — a
+million-request drill now costs O(1) memory per tier and the registry
+is directly scrapeable (``obs.render_prometheus``) / bridgeable to
+TensorBoard (``obs.SummaryBridge``).
 """
 
 from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
-import numpy as np
+from analytics_zoo_tpu.obs.registry import (MetricRegistry, nearest_rank)
 
 
 def percentile(xs: List[float], q: float) -> Optional[float]:
     """Nearest-rank percentile (deterministic, no interpolation noise
-    across numpy versions); None on empty."""
-    if not xs:
-        return None
-    s = sorted(xs)
-    k = min(len(s) - 1, max(0, int(np.ceil(q / 100.0 * len(s))) - 1))
-    return float(s[k])
+    across numpy versions); None on empty.  Kept as the public helper;
+    the per-tier snapshots now come from bounded reservoirs instead of
+    sorting full histories."""
+    return nearest_rank(sorted(float(x) for x in xs), q)
 
 
 class ServingMetrics:
-    """Aggregates per-request outcomes and per-dispatch observations."""
+    """Aggregates per-request outcomes and per-dispatch observations.
 
-    def __init__(self):
-        self.submitted = 0
-        self.completed = 0
-        self.failed = 0
-        self.shed_by_cause: Dict[str, int] = {}
+    ``registry``: the central :class:`MetricRegistry` everything
+    registers into (one is created when not supplied — the runtime
+    passes the session's, so serving metrics land beside train/data
+    metrics in the same snapshot).  Metric names: ``serve/submitted``,
+    ``serve/shed/cause=...``, ``serve/latency_s/tier=N``,
+    ``serve/batch_fill``, ``serve/queue_depth``, ``serve/redispatches``.
+    """
+
+    def __init__(self, registry: Optional[MetricRegistry] = None,
+                 reservoir: int = 2048):
+        self.registry = registry if registry is not None else MetricRegistry()
+        self.reservoir = int(reservoir)
+        self._r = self.registry
         self.deadline_misses = 0        # completed but late
-        self.batches = 0
-        self.batch_fill: List[float] = []       # n_valid / max_batch
-        self.queue_depth_samples: List[int] = []
-        self.latency_by_tier: Dict[int, List[float]] = {}
-        self.redispatches = 0
+        self._tiers: List[int] = []     # tiers with ≥1 completion, sorted
 
     # -- feed ----------------------------------------------------------------
     def on_submit(self) -> None:
-        self.submitted += 1
+        self._r.counter("serve/submitted").inc()
 
     def on_shed(self, cause: str) -> None:
-        self.shed_by_cause[cause] = self.shed_by_cause.get(cause, 0) + 1
+        self._r.counter(f"serve/shed/cause={cause}").inc()
 
     def on_complete(self, latency_s: float, tier: int, missed: bool) -> None:
-        self.completed += 1
-        self.latency_by_tier.setdefault(int(tier), []).append(
-            float(latency_s))
+        self._r.counter("serve/completed").inc()
+        tier = int(tier)
+        if tier not in self._tiers:
+            self._tiers = sorted(self._tiers + [tier])
+        self._r.histogram(f"serve/latency_s/tier={tier}",
+                          max_samples=self.reservoir).observe(latency_s)
         if missed:
             self.deadline_misses += 1
+            self._r.counter("serve/deadline_misses_completed_late").inc()
 
     def on_fail(self) -> None:
-        self.failed += 1
+        self._r.counter("serve/failed").inc()
 
     def on_batch(self, n_valid: int, max_batch: int,
                  queue_depth: int) -> None:
         # redispatches are counted post-dispatch by the runtime (the
         # failover latch is unknown before the pool runs the batch)
-        self.batches += 1
-        self.batch_fill.append(n_valid / max(max_batch, 1))
-        self.queue_depth_samples.append(int(queue_depth))
+        self._r.counter("serve/batches").inc()
+        self._r.histogram("serve/batch_fill",
+                          max_samples=self.reservoir).observe(
+            n_valid / max(max_batch, 1))
+        self._r.histogram("serve/queue_depth",
+                          max_samples=self.reservoir).observe(
+            float(queue_depth))
 
     # -- read ----------------------------------------------------------------
+    def _count(self, name: str) -> int:
+        return self._r.counter(name).value
+
+    @property
+    def submitted(self) -> int:
+        return self._count("serve/submitted")
+
+    @property
+    def completed(self) -> int:
+        return self._count("serve/completed")
+
+    @property
+    def failed(self) -> int:
+        return self._count("serve/failed")
+
+    @property
+    def batches(self) -> int:
+        return self._count("serve/batches")
+
+    @property
+    def redispatches(self) -> int:
+        return self._count("serve/redispatches")
+
+    @redispatches.setter
+    def redispatches(self, v: int) -> None:
+        c = self._r.counter("serve/redispatches")
+        if v < c.value:
+            raise ValueError("redispatches is monotonic")
+        c.inc(v - c.value)
+
+    @property
+    def shed_by_cause(self) -> Dict[str, int]:
+        prefix = "serve/shed/cause="
+        return {name[len(prefix):]: m.value
+                for name, m in self._r.metrics().items()
+                if name.startswith(prefix)}
+
     @property
     def shed_total(self) -> int:
         return sum(self.shed_by_cause.values())
@@ -81,15 +136,22 @@ class ServingMetrics:
         return missed / terminal
 
     def snapshot(self) -> Dict[str, Any]:
-        lat = {
-            str(tier): {
-                "n": len(xs),
-                "p50_s": percentile(xs, 50),
-                "p99_s": percentile(xs, 99),
-                "max_s": max(xs) if xs else None,
+        lat = {}
+        for tier in self._tiers:
+            h = self._r.histogram(f"serve/latency_s/tier={tier}",
+                                  max_samples=self.reservoir)
+            hs = h.snapshot()
+            lat[str(tier)] = {
+                "n": hs["count"],
+                "p50_s": hs["p50"],
+                "p99_s": hs["p99"],
+                "max_s": hs["max"],
+                "sampled": hs["sampled"],
             }
-            for tier, xs in sorted(self.latency_by_tier.items())
-        }
+        fill = self._r.histogram("serve/batch_fill",
+                                 max_samples=self.reservoir).snapshot()
+        depth = self._r.histogram("serve/queue_depth",
+                                  max_samples=self.reservoir).snapshot()
         return {
             "submitted": self.submitted,
             "completed": self.completed,
@@ -100,11 +162,9 @@ class ServingMetrics:
             "deadline_miss_rate": self.miss_rate(),
             "batches": self.batches,
             "redispatched_batches": self.redispatches,
-            "mean_batch_fill": (float(np.mean(self.batch_fill))
-                                if self.batch_fill else None),
-            "queue_depth_p50": percentile(
-                [float(x) for x in self.queue_depth_samples], 50),
-            "queue_depth_max": (max(self.queue_depth_samples)
-                                if self.queue_depth_samples else None),
+            "mean_batch_fill": fill["mean"],
+            "queue_depth_p50": depth["p50"],
+            "queue_depth_max": (int(depth["max"])
+                                if depth["max"] is not None else None),
             "latency_by_tier": lat,
         }

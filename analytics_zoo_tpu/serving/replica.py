@@ -61,9 +61,11 @@ class Replica:
         self.restart_at: Optional[float] = None
         self.dispatches = 0
         self.wedges = 0
+        # one time-source convention (utils.clock): the watchdog takes
+        # the Clock object itself since PR 7, no .now unwrapping
         self.watchdog = StallWatchdog(
             timeout_s=wedge_timeout_s, name=f"replica-{rid}",
-            clock=clock.now)
+            clock=clock)
 
     def forward(self, batch: AssembledBatch,
                 fault: Optional[Callable[["Replica"], None]] = None) -> Any:
@@ -119,25 +121,35 @@ class Replica:
 class ReplicaPool:
     """Round-robin dispatch over healthy replicas with fence + exactly-
     once failover.  ``events`` is the deterministic log the drill banks
-    (no wall-clock entries beyond the runtime clock's virtual time)."""
+    (no wall-clock entries beyond the runtime clock's virtual time).
+    ``observer`` (optional, set by the runtime) sees every event as it
+    is appended — the telemetry spine's flight recorder hangs off it,
+    and a fence event is one of the black box's dump triggers."""
 
     def __init__(self, replicas: Sequence[Replica], clock,
-                 restart_s: float = 5.0):
+                 restart_s: float = 5.0,
+                 observer: Optional[Callable[[Dict[str, Any]], None]] = None):
         if not replicas:
             raise ValueError("need at least one replica")
         self.replicas = list(replicas)
         self.clock = clock
         self.restart_s = float(restart_s)
         self.events: List[Dict[str, Any]] = []
+        self.observer = observer
         self._rr = 0
+
+    def _event(self, ev: Dict[str, Any]) -> None:
+        self.events.append(ev)
+        if self.observer is not None:
+            self.observer(ev)
 
     # -- selection -----------------------------------------------------------
     def _revive(self) -> None:
         now = self.clock.now()
         for r in self.replicas:
             if r.maybe_restart(now):
-                self.events.append({"kind": "replica_restarted",
-                                    "replica": r.rid, "t": round(now, 6)})
+                self._event({"kind": "replica_restarted",
+                             "replica": r.rid, "t": round(now, 6)})
 
     def healthy(self) -> List[Replica]:
         self._revive()
@@ -157,10 +169,10 @@ class ReplicaPool:
     def _fence(self, replica: Replica, err: ReplicaWedged) -> None:
         restart_at = self.clock.now() + self.restart_s
         replica.fence(restart_at)
-        self.events.append({"kind": "replica_fenced", "replica": replica.rid,
-                            "t": round(self.clock.now(), 6),
-                            "restart_at": round(restart_at, 6),
-                            "error": str(err).split("\n")[0][:160]})
+        self._event({"kind": "replica_fenced", "replica": replica.rid,
+                     "t": round(self.clock.now(), 6),
+                     "restart_at": round(restart_at, 6),
+                     "error": str(err).split("\n")[0][:160]})
         logger.warning("serving: fenced replica %d (%s); restart at t=%.3f",
                        replica.rid, err, restart_at)
 
@@ -188,10 +200,10 @@ class ReplicaPool:
                 raise ReplicaWedged(
                     f"batch failover from replica {replica.rid}: no healthy "
                     f"replica left") from err
-            self.events.append({"kind": "failover", "from": replica.rid,
-                                "to": backup.rid,
-                                "t": round(self.clock.now(), 6),
-                                "requests": [r.rid for r in batch.requests]})
+            self._event({"kind": "failover", "from": replica.rid,
+                         "to": backup.rid,
+                         "t": round(self.clock.now(), 6),
+                         "requests": [r.rid for r in batch.requests]})
             fault = fault_for(backup) if fault_for is not None else None
             try:
                 return self.dispatch_on(backup, batch, fault)

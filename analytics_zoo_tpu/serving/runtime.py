@@ -54,6 +54,10 @@ from analytics_zoo_tpu.serving.metrics import ServingMetrics
 from analytics_zoo_tpu.serving.replica import Replica, ReplicaPool
 from analytics_zoo_tpu.serving.request import AdmissionQueue, Request
 
+#: span trace-id for one request's life (submit → terminal) — the
+#: obs.span_conservation check keys on this prefix
+REQ_TRACE = "req-{rid}"
+
 logger = logging.getLogger("analytics_zoo_tpu")
 
 
@@ -87,7 +91,7 @@ class ServingRuntime:
                  ladder_policy: Optional[LadderPolicy] = None,
                  decision_every: int = 8,
                  shed_expired: bool = True,
-                 chaos=None):
+                 chaos=None, obs=None):
         if not tiers:
             raise ValueError("need at least one ServingTier")
         self.tiers = list(tiers)
@@ -96,9 +100,17 @@ class ServingRuntime:
         self.max_batch = int(max_batch)
         self.decision_every = int(decision_every)
         self.chaos = chaos
-        self.metrics = ServingMetrics()
+        # telemetry spine (obs.Observability): request-lifecycle spans
+        # into the flight recorder, metrics into the shared registry; a
+        # replica fence dumps the black box when a dump_path is armed
+        self.obs = obs
+        if obs is not None:
+            obs.adopt_clock(self.clock)
+        self.metrics = ServingMetrics(
+            registry=obs.registry if obs is not None else None)
         self.requests: List[Request] = []      # every request ever submitted
         self._rid = itertools.count()
+        self._spans: Dict[int, Dict[str, Any]] = {}   # rid -> open spans
         self._window_shed = 0
         self._dispatch_idx = 0                 # chaos serving-fault index
         self._since_decision = 0
@@ -121,13 +133,42 @@ class ServingRuntime:
             [Replica(r, forward_fns, self.clock, wedge_timeout_s,
                      service_hook=service_hook if virtual else None)
              for r in range(n_replicas)],
-            self.clock, restart_s=restart_s)
+            self.clock, restart_s=restart_s,
+            observer=self._on_pool_event if obs is not None else None)
         self.ladder = DegradationLadder(len(self.tiers), ladder_policy)
+
+    # -- telemetry -----------------------------------------------------------
+    def _on_pool_event(self, ev: Dict[str, Any]) -> None:
+        """Every pool event (fence / failover / restart) lands in the
+        flight recorder; a FENCE is a terminal condition — it trips the
+        black-box dump when one is armed."""
+        self.obs.recorder.record(ev)
+        if ev["kind"] == "replica_fenced" and self.obs.dump_path:
+            self.obs.dump("replica_fenced")
+
+    def _end_request_spans(self, req: Request, status: str,
+                           **attrs: Any) -> None:
+        if self.obs is None:
+            return
+        spans = self._spans.pop(req.rid, None)
+        if spans is None:
+            return
+        d = spans.get("dispatch")
+        if d is not None:
+            d.end(status=status, **attrs)
+        spans["root"].end(status=status)
 
     # -- shed observer -------------------------------------------------------
     def _on_shed(self, req: Request, cause: str) -> None:
         self.metrics.on_shed(cause)
         self._window_shed += 1
+        if self.obs is not None:
+            spans = self._spans.pop(req.rid, None)
+            if spans is not None:
+                q = spans.get("queue")
+                if q is not None:
+                    q.end(status=cause)
+                spans["root"].end(status=req.state, cause=cause)
 
     # -- client API ----------------------------------------------------------
     def submit(self, payload: Any, deadline_s: Optional[float] = None,
@@ -144,7 +185,18 @@ class ServingRuntime:
                       length=length)
         self.requests.append(req)
         self.metrics.on_submit()
-        self.queue.submit(req)          # may raise ServerOverloaded
+        if self.obs is not None:
+            # root span of this request's trace: opened here, closed at
+            # whatever terminal state the request reaches
+            root = self.obs.tracer.start(
+                "request", REQ_TRACE.format(rid=req.rid), rid=req.rid,
+                deadline_s=round(req.deadline_t - now, 6))
+            self._spans[req.rid] = {"root": root}
+        self.queue.submit(req)   # may raise; _on_shed closes the spans
+        if self.obs is not None and req.rid in self._spans:
+            spans = self._spans[req.rid]
+            spans["queue"] = self.obs.tracer.start(
+                "queue", spans["root"].trace_id, parent=spans["root"])
         return req
 
     # -- scheduler -----------------------------------------------------------
@@ -212,6 +264,27 @@ class ServingRuntime:
         self.metrics.on_batch(batch.n_valid, self.max_batch,
                               self.queue.depth)
         t0 = self.clock.now()
+        batch_span = None
+        if self.obs is not None:
+            # the batch gets its own trace (it belongs to N requests at
+            # once); each member request's queue span closes here and a
+            # per-request dispatch child opens under its root
+            batch_span = self.obs.tracer.start(
+                "batch", f"batch-{self._dispatch_idx}",
+                requests=[r.rid for r in batch.requests],
+                edge=str(batch.edge), n_valid=batch.n_valid,
+                tier=batch.tier)
+            for req in batch.requests:
+                spans = self._spans.get(req.rid)
+                if spans is None:
+                    continue
+                q = spans.pop("queue", None)
+                if q is not None:
+                    q.end(status="assembled", edge=str(batch.edge))
+                spans["dispatch"] = self.obs.tracer.start(
+                    "dispatch", spans["root"].trace_id,
+                    parent=spans["root"], tier=batch.tier,
+                    batch=self._dispatch_idx)
         try:
             out = self.pool.dispatch(batch, fault_for=self._fault_for)
         except ReplicaWedged as err:
@@ -219,6 +292,11 @@ class ServingRuntime:
             for req in batch.requests:
                 req.finish("failed", now, error=err)
                 self.metrics.on_fail()
+                self._end_request_spans(req, "failed",
+                                        attempts=req.attempts)
+            if batch_span is not None:
+                batch_span.end(status="failed",
+                               redispatched=batch.redispatched)
             self._after_dispatch(batch, t0, failed=True)
             return
         now = self.clock.now()
@@ -226,8 +304,13 @@ class ServingRuntime:
         for i, req in enumerate(batch.requests):
             req.tier = batch.tier
             req.finish("done", now, result=rows[i])
+            missed = now > req.deadline_t
             self.metrics.on_complete(now - req.arrival_t, batch.tier,
-                                     missed=now > req.deadline_t)
+                                     missed=missed)
+            self._end_request_spans(req, "done", attempts=req.attempts,
+                                    missed=missed)
+        if batch_span is not None:
+            batch_span.end(status="done", redispatched=batch.redispatched)
         self._after_dispatch(batch, t0, failed=False)
 
     def _after_dispatch(self, batch: AssembledBatch, t0: float,

@@ -1,7 +1,11 @@
-"""Utilities: engine/topology init, weight conversion, profiling."""
+"""Utilities: engine/topology init, weight conversion, profiling, and
+the shared injected clock (``utils.clock`` — promoted from
+``serving/clock.py`` so serving, the StallWatchdog, and the obs
+telemetry spine share one time-source convention)."""
 
 from analytics_zoo_tpu.utils import (
     caffe,
+    clock,
     convert,
     engine,
     profiling,

@@ -1,0 +1,81 @@
+"""The shared injected time source (promoted from ``serving/clock.py``).
+
+Every subsystem that makes time-based decisions — the serving runtime's
+deadline/shed/restart scheduling, :class:`~analytics_zoo_tpu.resilience.
+watchdog.StallWatchdog` stall deadlines, and the :mod:`analytics_zoo_tpu.
+obs` telemetry spine's span timestamps — reads time through ONE injected
+clock object instead of ``time.monotonic`` directly.  Production uses
+:class:`MonotonicClock`; tests and the committed drills use
+:class:`VirtualClock`, where time only moves when the harness says so: a
+4× overload burst with a mid-batch replica crash (and now its full span
+trace) replays bit-identically in milliseconds of real CPU, which is
+what lets ``RESILIENCE_r03.json`` and ``OBS_r01.json`` pin exact shed
+counts, tier transitions, and trace hashes.
+
+Before PR 7 there were two conventions: the serving package injected
+``Clock`` objects while ``StallWatchdog`` injected a bare ``now()``
+callable.  :func:`as_now_fn` bridges them — anything accepting a time
+source takes either and normalizes with it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Union
+
+
+class Clock:
+    """Interface: ``now()`` seconds (monotonic), ``sleep(s)``."""
+
+    def now(self) -> float:
+        raise NotImplementedError
+
+    def sleep(self, seconds: float) -> None:
+        raise NotImplementedError
+
+
+class MonotonicClock(Clock):
+    """Real wall time (``time.monotonic``)."""
+
+    def now(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(max(0.0, seconds))
+
+
+class VirtualClock(Clock):
+    """Deterministic manual time: ``now()`` returns the current virtual
+    instant; ``advance``/``sleep`` move it forward.  Single-threaded by
+    design — the serving runtime's scheduler is synchronous, so nothing
+    ever blocks waiting for another thread to advance the clock."""
+
+    def __init__(self, start: float = 0.0):
+        self._t = float(start)
+
+    def now(self) -> float:
+        return self._t
+
+    def advance(self, seconds: float) -> float:
+        if seconds < 0:
+            raise ValueError(f"cannot advance time backwards ({seconds})")
+        self._t += float(seconds)
+        return self._t
+
+    def sleep(self, seconds: float) -> None:
+        self.advance(seconds)
+
+
+TimeSource = Union[Clock, Callable[[], float], None]
+
+
+def as_now_fn(clock: TimeSource) -> Callable[[], float]:
+    """Normalize any accepted time source to a bare ``now()`` callable:
+    a :class:`Clock` object, an existing callable, or ``None`` (real
+    monotonic time).  THE normalizer — everything that accepts a time
+    source (watchdog, tracer, flight recorder) funnels through it."""
+    if clock is None:
+        return time.monotonic
+    if callable(clock):
+        return clock
+    return clock.now

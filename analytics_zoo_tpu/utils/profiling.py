@@ -38,10 +38,19 @@ def trace(log_dir: str):
 
 class StepTimer:
     """Accumulate per-step wall times + record counts; print throughput in
-    the reference Validator's format (``Validator.scala:82-86``)."""
+    the reference Validator's format (``Validator.scala:82-86``).
 
-    def __init__(self, name: str = "train"):
+    ``registry`` (optional, an :class:`analytics_zoo_tpu.obs.registry.
+    MetricRegistry`): every step also lands in the central registry —
+    a ``<name>/step_s`` bounded-reservoir histogram plus
+    ``<name>/records`` and ``<name>/steps`` counters — so the timer's
+    numbers appear in the same snapshot/Prometheus/TensorBoard surfaces
+    as the serving and data metrics instead of only in its own log
+    line."""
+
+    def __init__(self, name: str = "train", registry=None):
         self.name = name
+        self.registry = registry
         self.times: List[float] = []
         self.records = 0
         self._t0: Optional[float] = None
@@ -51,11 +60,21 @@ class StepTimer:
         return self
 
     def __exit__(self, *exc):
-        self.times.append(time.perf_counter() - self._t0)
+        if self._t0 is None:
+            raise RuntimeError(f"StepTimer[{self.name}]: __exit__ without "
+                               "a matching __enter__")
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        self.times.append(dt)
+        if self.registry is not None:
+            self.registry.histogram(f"{self.name}/step_s").observe(dt)
+            self.registry.counter(f"{self.name}/steps").inc()
 
     def step(self, n_records: int = 0):
         """Use as ``with timer.step(n):`` — counts records too."""
         self.records += n_records
+        if self.registry is not None and n_records:
+            self.registry.counter(f"{self.name}/records").inc(n_records)
         return self
 
     def summary(self) -> Dict[str, float]:

@@ -1448,6 +1448,173 @@ def bench_serve_sched(args):
                       "offered load as a fraction of tier-0 capacity")
 
 
+def obs_overhead_ab(hidden: int = 1024, in_dim: int = 32, batch: int = 128,
+                    steps: int = 4, chunks: int = 30, warmup: int = 5):
+    """Instrumented-vs-bare train-step A/B — the telemetry spine's cost,
+    measured instead of assumed.
+
+    Both sides run the SAME compiled train step over the SAME resident
+    batch; the instrumented side additionally does exactly what
+    ``Optimizer.set_observability`` does per step — start/end a span at
+    the step's loader coordinates (two clock reads + a ring append) and
+    feed a ``StepTimer`` registering into the shared ``MetricRegistry``
+    (a reservoir observe + two counter incs).
+
+    Measurement design: the signal is O(µs)/step against ~ms steps, so
+    long A/B windows drown it in scheduler noise (observed ±30 % per
+    window on a contended host).  Two mitigations, both banked: (1) the
+    sides alternate in FINE-GRAINED pairs of short ``steps``-step
+    chunks over a deliberately LARGE step (~25 ms at the defaults) with
+    the headline as the RATIO OF TOTAL TIMES — local drift lands on
+    both sides of each pair almost equally and cancels in the sums,
+    per-pair ratios kept as the dispersion readout; (2) a DIRECT
+    microbench of the pure instrumentation ops (span + StepTimer +
+    registry, no jax) gives the per-step cost free of e2e noise —
+    ``overhead_fraction_direct`` is that cost over the measured bare
+    step time, and — being the only number resolvable above the e2e
+    noise floor — is what the ≤ 3 % acceptance gates on (the ratio is
+    banked as the no-hidden-systematic-cost evidence).  Returns the
+    dict ``tools/obs_drill.py`` banks into ``OBS_r01.json``."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from analytics_zoo_tpu.core.criterion import MSECriterion
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.obs import Observability
+    from analytics_zoo_tpu.parallel import Adam, create_train_state, \
+        make_train_step
+    from analytics_zoo_tpu.utils.profiling import StepTimer
+
+    class MLP(nn.Module):
+        hidden: int
+
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(self.hidden)(x))
+            x = nn.relu(nn.Dense(self.hidden)(x))
+            return nn.Dense(1)(x)
+
+    model = Model(MLP(hidden))
+    model.build(0, jnp.zeros((1, in_dim), jnp.float32))
+    optim = Adam(1e-3)
+    step = make_train_step(model.module, MSECriterion(), optim)
+    rng = np.random.RandomState(0)
+    dev_batch = {
+        "input": jnp.asarray(rng.randn(batch, in_dim), jnp.float32),
+        "target": jnp.asarray(rng.randn(batch, 1), jnp.float32)}
+    state = create_train_state(model, optim)
+    for _ in range(warmup):                      # compile + settle
+        state, metrics = step(state, dev_batch, 1.0)
+    jax.block_until_ready(metrics["loss"])
+
+    obs = Observability(capacity=max(4096, chunks * steps + 64))
+    timer = StepTimer("train/dispatch", registry=obs.registry)
+    tracer = obs.tracer
+    counters = {"it": 0}
+
+    def chunk_bare():
+        nonlocal state
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, dev_batch, 1.0)
+        jax.block_until_ready(metrics["loss"])
+        return time.perf_counter() - t0
+
+    def chunk_instrumented():
+        nonlocal state
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            it = counters["it"]
+            span = tracer.start("train_step", f"train-e0-b{it}",
+                                iteration=it, epoch=0, batch=it)
+            with timer.step(batch):
+                state, metrics = step(state, dev_batch, 1.0)
+            span.end(status="ok")
+            counters["it"] = it + 1
+        jax.block_until_ready(metrics["loss"])
+        return time.perf_counter() - t0
+
+    t_bare = t_instr = 0.0
+    pair_ratios = []                 # per-pair instr/bare RATE ratio
+    for c in range(chunks):
+        if c % 2 == 0:
+            b = chunk_bare()
+            i = chunk_instrumented()
+        else:
+            i = chunk_instrumented()
+            b = chunk_bare()
+        t_bare += b
+        t_instr += i
+        pair_ratios.append(b / max(i, 1e-12))
+    ratio = t_bare / t_instr         # instrumented/bare rate, on totals
+
+    # direct microbench: the pure per-step instrumentation ops with a
+    # no-op "step" — the µs-scale cost, free of e2e scheduler noise
+    obs_d = Observability(capacity=4096)
+    timer_d = StepTimer("train/dispatch", registry=obs_d.registry)
+    n_direct = 5000
+    t0 = time.perf_counter()
+    for i in range(n_direct):
+        span = obs_d.tracer.start("train_step", f"train-e0-b{i}",
+                                  iteration=i, epoch=0, batch=i)
+        with timer_d.step(batch):
+            pass
+        span.end(status="ok")
+    instr_us = (time.perf_counter() - t0) / n_direct * 1e6
+    bare_step_us = t_bare / (chunks * steps) * 1e6
+    direct_frac = instr_us / bare_step_us
+    return {
+        "config": {"hidden": hidden, "in_dim": in_dim, "batch": batch,
+                   "steps_per_chunk": steps, "chunk_pairs": chunks},
+        "bare_steps_per_sec": round(chunks * steps / t_bare, 2),
+        "instrumented_steps_per_sec": round(chunks * steps / t_instr, 2),
+        "pair_ratio_p25_p50_p75": [
+            round(_median(sorted(pair_ratios)[:len(pair_ratios) // 2]), 4),
+            round(_median(pair_ratios), 4),
+            round(_median(sorted(pair_ratios)[len(pair_ratios) // 2:]), 4)],
+        "ratio_of_totals": round(ratio, 4),
+        "overhead_fraction": round(1.0 - ratio, 4),
+        "instrumentation_us_per_step": round(instr_us, 2),
+        "bare_step_us": round(bare_step_us, 1),
+        "overhead_fraction_direct": round(direct_frac, 5),
+        "spans_recorded": obs.tracer.spans_ended,
+        "ring_dropped": obs.recorder.dropped,
+        "registry_step_count": obs.registry.histogram(
+            "train/dispatch/step_s").count,
+        # the GATE is the direct measurement: the e2e ratio's noise
+        # floor on a contended host (measured swings up to ±8 % of
+        # TOTALS) sits above the µs-scale signal, so gating on it would
+        # flake in both directions — it is banked as evidence that no
+        # hidden systematic cost exists (ratio ≈ 1 within noise), while
+        # the direct per-step cost over the measured bare step time is
+        # the resolvable overhead number the bound applies to
+        "overhead_le_3pct": direct_frac <= 0.03,
+    }
+
+
+def bench_obs_overhead(args):
+    """bench.py phase wrapper: emit the instrumented-vs-bare A/B as one
+    line; the committed execution lives in ``OBS_r01.json``
+    (``tools/obs_drill.py`` calls :func:`obs_overhead_ab` directly)."""
+    quick = args.quick
+    # --quick only shortens the run (fewer chunk pairs); the MODEL
+    # geometry stays at the full-size default — the spine's ~µs/step
+    # host cost only reads meaningfully against a realistic ~25 ms step
+    out = obs_overhead_ab(chunks=10 if quick else 60)
+    return _emit("obs_overhead_step_ratio", out["ratio_of_totals"],
+                 "instrumented/bare", None,
+                 overhead_fraction=out["overhead_fraction"],
+                 overhead_le_3pct=out["overhead_le_3pct"],
+                 spans_recorded=out["spans_recorded"],
+                 config=out["config"],
+                 pair_ratio_p25_p50_p75=out["pair_ratio_p25_p50_p75"],
+                 note="per-step cost of the obs spine (span + StepTimer "
+                      "+ registry) on the Optimizer hot path; acceptance "
+                      "<= 3% overhead")
+
+
 def bench_detection_output_backends(args):
     """Pallas NMS vs XLA NMS on the same batch: parity + speed, on the
     real chip (VERDICT round-1 item 6)."""
@@ -1619,7 +1786,8 @@ def main() -> int:
                         "3-12x between processes — one draw is weather, "
                         "the median is climate)")
     p.add_argument("--skip", default="",
-                   help="comma list: link,nms,ds2,ds2_train,ds2_ragged,"
+                   help="comma list: link,serve_sched,obs_overhead,nms,"
+                        "ds2,ds2_train,ds2_ragged,"
                         "ds2_persistent,ssd_serve,"
                         "ssd512_serve,frcnn_serve,frcnn_train,"
                         "ssd512_step,overlap,host_wall,ssd_train,"
@@ -1658,7 +1826,8 @@ def main() -> int:
     # cheap phases first so a flaky relay still leaves recorded metrics;
     # the link probe leads (it contextualizes every later number);
     # ssd_train stays last (the driver reads the LAST line as headline)
-    ALL_PHASES = ["link", "serve_sched", "nms", "ds2", "ds2_train",
+    ALL_PHASES = ["link", "serve_sched", "obs_overhead", "nms", "ds2",
+                  "ds2_train",
                   "ds2_ragged", "ds2_persistent", "ssd_serve",
                   "ssd512_serve", "frcnn_serve",
                   "frcnn_train", "ssd512_step", "overlap", "host_wall",
@@ -1833,6 +2002,8 @@ def main() -> int:
             bench_link_probe(args)
         if "serve_sched" not in skip:
             bench_serve_sched(args)     # host-only, never touches a device
+        if "obs_overhead" not in skip:
+            bench_obs_overhead(args)    # telemetry-spine step-cost A/B
         if "ssd_train" not in skip:
             headline = bench_ssd_train(args, mesh, pattern, device_aug=True)
         if "overlap" not in skip:

@@ -336,6 +336,29 @@ class TestDegradationLadder:
 
 
 class TestMetricsSnapshot:
+    def test_latency_memory_bounded_by_reservoir(self):
+        """PR 7 satellite: per-tier latency used to be an unbounded list
+        full-sorted per snapshot; it is now a bounded reservoir in the
+        central registry — O(1) memory per tier at any request count,
+        exact below capacity, honest ``sampled`` flag past it."""
+        from analytics_zoo_tpu.serving import ServingMetrics
+
+        m = ServingMetrics(reservoir=64)
+        for i in range(10_000):
+            m.on_complete(i * 1e-4, tier=0, missed=False)
+        h = m.registry.histogram("serve/latency_s/tier=0", max_samples=64)
+        assert len(h.samples) == 64 and h.count == 10_000
+        snap = m.snapshot()["latency_by_tier"]["0"]
+        assert snap["n"] == 10_000 and snap["sampled"] is True
+        assert snap["max_s"] == pytest.approx(0.9999)
+        # exact (not sampled) below reservoir capacity
+        m2 = ServingMetrics(reservoir=64)
+        for v in (0.3, 0.1, 0.2):
+            m2.on_complete(v, tier=1, missed=False)
+        s2 = m2.snapshot()["latency_by_tier"]["1"]
+        assert s2 == {"n": 3, "p50_s": 0.2, "p99_s": 0.3, "max_s": 0.3,
+                      "sampled": False}
+
     def test_snapshot_shape(self):
         clock = VirtualClock()
         rt = _runtime(clock)

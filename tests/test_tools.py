@@ -304,6 +304,75 @@ class TestServeDrillHelpers:
                 < out["miss_rate"]["baseline_no_shedding"])
 
 
+class TestObsDrillHelpers:
+    """Fast pieces of tools/obs_drill.py (the committed OBS_r01.json is
+    the full-size execution: drill-scale flight recording + replay hash
+    + overhead A/B)."""
+
+    def test_traced_scenario_span_conservation_smoke(self):
+        from analytics_zoo_tpu.obs import span_conservation
+        from tools.obs_drill import traced_scenario
+
+        rt, obs, n_script = traced_scenario(seed=0, smoke=True)
+        acct = rt.accounting()
+        cons = span_conservation(obs.recorder.events())
+        # the spine's hard invariants at smoke scale: every request is
+        # one rooted trace, nothing dropped from the ring, and the root
+        # statuses reconcile exactly with the runtime's own accounting
+        assert cons["ok"], cons["violations"]
+        assert obs.recorder.dropped == 0
+        assert cons["traces"] == acct["submitted"] >= n_script
+        assert cons["roots_by_status"] == acct["by_state"]
+
+    def test_committed_obs_artifact_passes_its_own_checks(self):
+        import json
+
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "OBS_r01.json")
+        report = json.load(open(path))
+        assert report["verdict"] == "PASS" and report["checks"]["ok"]
+        assert report["serve_trace"]["replay_identical"] is True
+        assert report["obs_overhead"]["overhead_le_3pct"] is True
+        assert report["serve_trace"]["events_dropped"] == 0
+
+
+class TestCheckArtifacts:
+    """Satellite: the committed-artifact lint runs in tier-1 — a stale,
+    hand-edited, or unstamped new artifact fails the suite."""
+
+    def test_repo_artifacts_all_parse_and_new_ones_are_stamped(self):
+        from tools.check_artifacts import check_artifacts
+
+        root = os.path.join(os.path.dirname(__file__), os.pardir)
+        assert check_artifacts(root) == []
+
+    def test_unstamped_or_unparseable_artifact_fails(self, tmp_path):
+        from tools import check_artifacts as ca
+
+        (tmp_path / "NEW_r09.json").write_text('{"no": "metadata"}\n')
+        (tmp_path / "OBS_r99.json").write_text("{truncated\n")
+        (tmp_path / "PARTIAL_r01.json").write_text(
+            '{"run_metadata": {"tool": "x"}}\n')
+        (tmp_path / "unrelated.json").write_text("{not linted")
+        problems = ca.check_artifacts(str(tmp_path))
+        assert len(problems) == 3
+        assert any("NEW_r09" in p and "missing run_metadata" in p
+                   for p in problems)
+        assert any("OBS_r99" in p and "parse" in p for p in problems)
+        assert any("PARTIAL_r01" in p and "missing keys" in p
+                   for p in problems)
+        assert ca.main(["--root", str(tmp_path)]) == 1
+
+    def test_legacy_artifacts_are_grandfathered_but_must_parse(
+            self, tmp_path):
+        from tools import check_artifacts as ca
+
+        (tmp_path / "RESILIENCE_r01.json").write_text('{"old": true}\n')
+        assert ca.check_artifacts(str(tmp_path)) == []
+        (tmp_path / "RESILIENCE_r01.json").write_text("{broken")
+        assert len(ca.check_artifacts(str(tmp_path))) == 1
+
+
 class TestProfileMfuRnnAb:
     def test_rnn_ab_smoke_writes_h2h_share_artifact(self, tmp_path):
         """Satellite (ISSUE 6): `tools/profile_mfu.py --rnn-ab` — the

@@ -109,8 +109,15 @@ def shard_read_drill(tmpdir: str, rng: random.Random) -> dict:
                                 backoff_s=0.01, stats=stats, opener=opener))
     survived = (len(got) == n_records - 1 and stats.retries == len(fail_calls)
                 and stats.skipped_records == 1 and stats.skipped_shards == 0)
+    # the PR-7 registry path: the artifact carries the read stats in
+    # the central snapshot schema, same shape an operator would scrape
+    from analytics_zoo_tpu.obs import MetricRegistry
+
+    registry = MetricRegistry()
+    stats.publish(registry)
     return {
         "kind": "shard_read_error",
+        "registry": registry.snapshot(),
         "injected_transient_errors": len(fail_calls),
         "injected_corrupt_records": 1,
         "records_written": n_records,
@@ -444,6 +451,8 @@ def main(argv=None) -> int:
         training = training_drill(tmpdir, rng, args.smoke)
         anomaly = anomaly_drill(tmpdir, rng, args.smoke)
 
+    from analytics_zoo_tpu.obs import run_metadata
+
     kinds = sorted(set(e["kind"] for e in training["faults_fired"])
                    | set(e["kind"] for e in anomaly["faults_fired"])
                    | ({"shard_read_error"} if shard["survived"] else set()))
@@ -454,6 +463,10 @@ def main(argv=None) -> int:
         "revision": "r02",
         "seed": args.seed,
         "smoke": bool(args.smoke),
+        # shared stamping block (obs.run_metadata) — checked by
+        # tools/check_artifacts.py so the artifact ties to a commit
+        "run_metadata": run_metadata("chaos_drill", seed=args.seed,
+                                     extra={"smoke": bool(args.smoke)}),
         "shard_read": shard,
         "training": training,
         "anomaly": anomaly,

@@ -81,9 +81,12 @@ def build_arrival_script(rng: random.Random, smoke: bool, monkey) -> list:
 
 
 def run_scenario(script, tiers, tier_speeds, *, shed, chaos=None,
-                 queue_capacity, ladder_policy=None):
+                 queue_capacity, ladder_policy=None, obs=None):
     """Replay one arrival script against a fresh runtime; returns the
-    runtime (drained: every request terminal)."""
+    runtime (drained: every request terminal).  ``obs`` (an
+    ``analytics_zoo_tpu.obs.Observability``) arms the telemetry spine —
+    request-lifecycle spans land in its flight recorder on the SAME
+    virtual clock, which is what ``tools/obs_drill.py`` banks."""
     import numpy as np
 
     from analytics_zoo_tpu.serving import ServingRuntime, VirtualClock
@@ -99,7 +102,8 @@ def run_scenario(script, tiers, tier_speeds, *, shed, chaos=None,
         queue_capacity=queue_capacity, max_batch=8,
         default_deadline_s=0.3, wedge_timeout_s=1.5, restart_s=2.0,
         service_time=service_time, ladder_policy=ladder_policy,
-        decision_every=DECISION_EVERY, shed_expired=shed, chaos=chaos)
+        decision_every=DECISION_EVERY, shed_expired=shed, chaos=chaos,
+        obs=obs)
 
     from analytics_zoo_tpu.resilience.errors import ServerOverloaded
 
@@ -142,21 +146,22 @@ def run_scenario(script, tiers, tier_speeds, *, shed, chaos=None,
     return rt
 
 
-def serving_drill(seed: int, smoke: bool) -> dict:
+def drill_tiers(seed: int) -> list:
+    """The drill's degradation ladder: a real jitted flax Dense tier 0
+    and a REAL weight-only int8 tier 1 through ``quantize_params`` /
+    ``make_quantized_forward`` (the SSD ladder's tier-1 mechanism, tiny
+    here so the drill replays in ~a second on CPU).  Shared with
+    ``tools/obs_drill.py`` so the traced drill serves the same model."""
     import flax.linen as nn
     import jax.numpy as jnp
     import numpy as np
 
     from analytics_zoo_tpu.core.module import Model
     from analytics_zoo_tpu.parallel import make_eval_step
-    from analytics_zoo_tpu.resilience.chaos import ChaosMonkey, FaultSpec
-    from analytics_zoo_tpu.serving.ladder import LadderPolicy, ServingTier
+    from analytics_zoo_tpu.serving.ladder import ServingTier
     from analytics_zoo_tpu.utils.quantize import (make_quantized_forward,
                                                   quantize_params)
 
-    # real model + real int8 path: fp32 Dense tier 0, quantize_params
-    # weight-only tier 1 (the SSD ladder's tier-1 mechanism, tiny here so
-    # the drill replays in ~a second on CPU)
     model = Model(nn.Dense(4))
     model.build(seed, jnp.zeros((1, 16), jnp.float32))
     eval_step = make_eval_step(model.module)
@@ -170,11 +175,18 @@ def serving_drill(seed: int, smoke: bool) -> dict:
     def fwd_int8(batch):
         return np.asarray(qfwd(qparams, jnp.asarray(batch["input"])))
 
-    tiers = [ServingTier("fp", fwd_fp, speed=1.0,
-                         quality_note="fp32 weights"),
-             ServingTier("int8", fwd_int8, speed=0.5,
-                         quality_note="weight-only int8 "
-                                      "(quantize_params)")]
+    return [ServingTier("fp", fwd_fp, speed=1.0,
+                        quality_note="fp32 weights"),
+            ServingTier("int8", fwd_int8, speed=0.5,
+                        quality_note="weight-only int8 "
+                                     "(quantize_params)")]
+
+
+def serving_drill(seed: int, smoke: bool) -> dict:
+    from analytics_zoo_tpu.resilience.chaos import ChaosMonkey, FaultSpec
+    from analytics_zoo_tpu.serving.ladder import LadderPolicy
+
+    tiers = drill_tiers(seed)
     tier_speeds = [t.speed for t in tiers]
     scale = 4 if smoke else 1
 
@@ -308,12 +320,19 @@ def main(argv=None) -> int:
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+    from analytics_zoo_tpu.obs import run_metadata
+
     result = serving_drill(args.seed, args.smoke)
     report = {
         "drill": "serve_drill",
         "revision": REVISION,
         "seed": args.seed,
         "smoke": bool(args.smoke),
+        # the shared stamping block (obs.run_metadata): ties the
+        # artifact to a commit/backend — tools/check_artifacts.py lints
+        # its presence in every newly committed *_r*.json
+        "run_metadata": run_metadata("serve_drill", seed=args.seed,
+                                     extra={"smoke": bool(args.smoke)}),
         **result,
         "verdict": "PASS" if result["checks"]["ok"] else "FAIL",
     }
