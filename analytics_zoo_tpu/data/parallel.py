@@ -551,6 +551,17 @@ class ParallelLoader:
         self.slot_bytes = slot_bytes
         self.max_respawns = max_respawns
         self._epoch = start_epoch
+        if start_epoch:
+            # resume contract (mid-epoch checkpoint restart): the caller
+            # hands a FRESHLY-constructed dataset plus the checkpointed
+            # epoch, and the loader owns BOTH halves of the coordinate —
+            # the per-epoch seeding keys (stable_seed folds the epoch
+            # index) AND the source's own per-epoch closure state
+            # (e.g. from_arrays' reshuffle counter), which replay_batches
+            # always had to advance by hand.  Without this, a resumed
+            # process replays epoch 0's sample ORDER under epoch N's
+            # seeds — a silently different stream.
+            _advance_source_epochs(self.dataset._source_fn, start_epoch)
         self.leading, self.chain, self.trailing = split_stages(
             dataset._stages)
         # construction-time RNG signatures: the per-epoch reseed of

@@ -42,6 +42,12 @@ from analytics_zoo_tpu.parallel.train import (
     state_to_variables,
     validate,
 )
+from analytics_zoo_tpu.parallel.specs import (
+    SpecSet,
+    pipeline_specs,
+    register_pipeline,
+    registered_pipelines,
+)
 from analytics_zoo_tpu.parallel.summary import TrainSummary, ValidationSummary
 from analytics_zoo_tpu.parallel import checkpoint
 from analytics_zoo_tpu.parallel.expert import (

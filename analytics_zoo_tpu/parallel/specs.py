@@ -1,0 +1,270 @@
+"""Declare-once PartitionSpecs: the single sharding substrate.
+
+The reference distributes one way — synchronous data-parallel replicas
+over Spark executors (``DistriOptimizer``, SURVEY.md §2.7) — and every
+entry point re-implements that placement.  Before this module our TPU
+port had started to mirror the same drift: ``parallel/mesh.py`` placed
+data-parallel batches, ``parallel/tensor.py`` placed tensor-parallel
+weights, and each pipeline picked its own combination inline.  Here the
+GSPMD/pjit pattern (SNIPPETS.md [1]–[3]) is made the ONE convention:
+
+* a pipeline declares its PartitionSpec tree **exactly once** — a
+  :class:`SpecSet` built from the registry below — and everything that
+  places arrays (``make_train_step``/``make_eval_step`` jit
+  ``in_shardings``/``out_shardings``, ``Optimizer._place_state``, the
+  serving predictors) consumes that object;
+* data/tensor/pipeline parallelism then compose by changing the MESH
+  SHAPE, not the pipeline: the same declared specs resolve against a
+  ``(8,)`` data mesh, a ``(2, 4)`` data×model mesh, or a multi-host
+  mesh, with non-divisible dims degrading to replicated
+  (``tensor.partition_spec``).
+
+Axis conventions (``parallel.mesh``): ``data`` carries dim 0 of every
+batch leaf; ``model`` carries weight shards (Megatron rules) or image
+height (spatial partitioning); ``sequence`` carries time.  Parameters
+without a matching rule are replicated — sharding is an optimization,
+never a correctness requirement.
+
+Registry::
+
+    specs = pipeline_specs("ds2", mesh=mesh)          # declared once
+    state = specs.place_state(create_train_state(model, optim))
+    step = make_train_step(model.module, crit, optim, specs=specs)
+    ...                                # jit places host batches itself
+
+``tests/test_specs.py`` pins the contract: every registered pipeline's
+spec tree structure-matches its param tree, and a shard→gather
+roundtrip is byte-identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from analytics_zoo_tpu.parallel import mesh as mesh_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecSet:
+    """One pipeline's declared sharding: mesh + state rules + batch specs.
+
+    ``rules``: ``parallel.tensor`` ``(path_regex, spec_fn)`` pairs
+    resolving parameter/optimizer-slot leaves (``None`` = everything
+    replicated — pure data parallelism).  ``batch_overrides``: top-level
+    batch keys whose leaves take an explicit PartitionSpec instead of the
+    default dim-0-over-``data`` (e.g. spatial tensor parallelism's
+    ``{"input": tensor.spatial_input_spec()}``).
+
+    The object is both the *declaration* (spec trees, for tests and
+    docs) and the *placement engine* (``place_state``/``place_batch``/
+    jit sharding annotations) — one source of truth, so a refactor
+    cannot change where arrays land without changing what the pipeline
+    declared.
+    """
+
+    mesh: Mesh
+    rules: Optional[Sequence] = None
+    batch_overrides: Optional[Dict[str, P]] = None
+
+    # -- spec trees (the declaration) -----------------------------------
+    def state_specs(self, state: Any) -> Any:
+        """PartitionSpec tree structure-matching ``state`` (a params dict
+        or a whole TrainState; optimizer slots mirror their parameter's
+        spec through path matching)."""
+        from analytics_zoo_tpu.parallel import tensor as tensor_lib
+
+        if self.rules is None:
+            return jax.tree_util.tree_map(lambda _: P(), state)
+        return tensor_lib.spec_tree(state, self.mesh, self.rules)
+
+    def batch_specs(self, batch: Any) -> Any:
+        """PartitionSpec tree for one batch pytree: dim 0 over ``data``,
+        scalars replicated, ``batch_overrides`` honored per top-level
+        key."""
+        axis = mesh_lib.data_axis(self.mesh)
+
+        def default(leaf):
+            arr = np.asarray(leaf) if not hasattr(leaf, "ndim") else leaf
+            if arr.ndim == 0:
+                return P()
+            return P(*([axis] + [None] * (arr.ndim - 1)))
+
+        if not (self.batch_overrides and isinstance(batch, dict)):
+            return jax.tree_util.tree_map(default, batch)
+        return {k: (jax.tree_util.tree_map(
+                        lambda leaf, k=k: self.batch_overrides[k], v)
+                    if k in self.batch_overrides
+                    else jax.tree_util.tree_map(default, v))
+                for k, v in batch.items()}
+
+    # -- jit annotations ------------------------------------------------
+    @property
+    def replicated(self) -> NamedSharding:
+        """Replicated NamedSharding — scalars (lr, metrics) and, as a
+        pytree prefix, whole replicated trees (variables, DP state)."""
+        return NamedSharding(self.mesh, P())
+
+    @property
+    def data_axis_size(self) -> int:
+        """Width of the batch-carrying mesh axis (replica count)."""
+        return int(self.mesh.shape[mesh_lib.data_axis(self.mesh)])
+
+    @property
+    def data_sharding(self) -> NamedSharding:
+        """Dim-0-over-``data`` NamedSharding; as a jit pytree PREFIX it
+        broadcasts over a whole batch tree of batch-major leaves."""
+        return NamedSharding(self.mesh, P(mesh_lib.data_axis(self.mesh)))
+
+    def state_shardings(self, state: Any = None):
+        """jit ``in_shardings``/``out_shardings`` entry for the train
+        state.  Pure data parallelism needs no structure — a replicated
+        prefix covers any state tree; with rules armed the concrete
+        ``state`` is required to resolve per-leaf specs."""
+        if self.rules is None:
+            return self.replicated
+        if state is None:
+            raise ValueError("state_shardings with tensor-parallel rules "
+                             "needs the concrete state tree")
+        return jax.tree_util.tree_map(
+            lambda spec: NamedSharding(self.mesh, spec),
+            self.state_specs(state))
+
+    def batch_shardings(self):
+        """jit ``in_shardings`` entry for batches, or ``None`` when jit
+        cannot place them (per-key overrides need the spec layer's own
+        ``place_batch``; jit prefixes cannot express per-key specs over
+        an open batch structure)."""
+        if self.batch_overrides:
+            return None
+        return self.data_sharding
+
+    def ragged_dispatch(self, annotated: Callable, plain: Callable
+                        ) -> Callable:
+        """ONE routing rule for annotated serving/eval programs, owned
+        by the spec layer: ``dispatch(variables, *batch_args)`` runs the
+        mesh-``annotated`` program when the first batch argument's
+        leading dim divides the data axis, and the ``plain`` program for
+        ragged tails (remainder predict/validation batches) or 0-d
+        probes.  `make_eval_step` and the serving predictors share this
+        instead of hand-rolling divergent copies."""
+        width = self.data_axis_size
+
+        def dispatch(variables, *args):
+            leaf = jax.tree_util.tree_leaves(args[0])[0]
+            shape = getattr(leaf, "shape", None)
+            if shape and shape[0] % width == 0:
+                return annotated(variables, *args)
+            return plain(variables, *args)
+
+        return dispatch
+
+    def jit_places_batches(self) -> bool:
+        """True when host batches can go straight into the annotated jit
+        (single-process mesh, no per-key overrides) — the GSPMD
+        declare-once fast path.  Multi-process meshes assemble global
+        arrays from per-host shards (``place_batch``) instead."""
+        return (self.batch_shardings() is not None
+                and not mesh_lib.spans_processes(self.mesh))
+
+    # -- placement (the one device_put site) ----------------------------
+    def place_state(self, state: Any) -> Any:
+        """Host state pytree → mesh placement per the declared specs:
+        replicate (multi-host aware) without rules, rule-resolved
+        ``NamedSharding`` placement with them."""
+        from analytics_zoo_tpu.parallel import tensor as tensor_lib
+
+        if self.rules is None:
+            return mesh_lib.replicate(state, self.mesh)
+        return tensor_lib.shard_tree(state, self.mesh, self.rules)
+
+    def place_batch(self, batch: Any) -> Any:
+        """Host batch pytree → mesh placement (dim 0 over ``data``,
+        overrides honored, multi-host local-shard assembly)."""
+        return mesh_lib.shard_batch(batch, self.mesh,
+                                    overrides=self.batch_overrides)
+
+    def gather(self, tree: Any) -> Any:
+        """Device pytree → host numpy copy (replicated leaves read their
+        local replica; byte-identical to what was placed — the
+        roundtrip ``tests/test_specs.py`` pins)."""
+        return mesh_lib.host_local_state(tree)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline registry — every entry point declares here, once
+# ---------------------------------------------------------------------------
+
+_PIPELINES: Dict[str, Callable[..., SpecSet]] = {}
+
+
+def register_pipeline(name: str):
+    """Register a ``builder(mesh, **opts) -> SpecSet`` under ``name``.
+    ``tests/test_specs.py`` iterates the registry, so a new pipeline
+    gets the structure-match + roundtrip guards for free."""
+    def deco(fn: Callable[..., SpecSet]):
+        _PIPELINES[name] = fn
+        return fn
+    return deco
+
+
+def registered_pipelines() -> Sequence[str]:
+    return tuple(sorted(_PIPELINES))
+
+
+def pipeline_specs(name: str, mesh: Optional[Mesh] = None,
+                   **opts: Any) -> SpecSet:
+    """The declared :class:`SpecSet` for a registered pipeline on
+    ``mesh`` (default: 1-D data mesh over every device)."""
+    if name not in _PIPELINES:
+        raise KeyError(f"no specs registered for pipeline {name!r} "
+                       f"(registered: {', '.join(registered_pipelines())})")
+    return _PIPELINES[name](mesh or mesh_lib.create_mesh(), **opts)
+
+
+@register_pipeline("ssd")
+def _ssd_specs(mesh: Mesh, tp: Optional[str] = None,
+               resolution: int = 300) -> SpecSet:
+    """SSD detection training/serving.  ``tp=None``: pure data parallel
+    (params replicated).  ``tp="spatial"``: image HEIGHT over ``model``
+    — the conv-trunk mode that measured 2.1× faster than channel
+    sharding (TP_MICROBENCH.json).  ``tp="megatron"``: paired col/row
+    weight sharding (``tensor.ssd_tp_rules``)."""
+    from analytics_zoo_tpu.parallel import tensor as tensor_lib
+
+    if tp is None:
+        return SpecSet(mesh)
+    if tp == "spatial":
+        return SpecSet(mesh, batch_overrides={
+            "input": tensor_lib.spatial_input_spec()})
+    if tp == "megatron":
+        return SpecSet(mesh,
+                       rules=tensor_lib.ssd_tp_rules(resolution=resolution))
+    raise ValueError(f"ssd tp mode {tp!r} (None | 'spatial' | 'megatron')")
+
+
+@register_pipeline("frcnn")
+def _frcnn_specs(mesh: Mesh) -> SpecSet:
+    """Faster-RCNN joint training: data parallel (the proposal/ROI ops
+    are batch-local; weights replicated)."""
+    return SpecSet(mesh)
+
+
+@register_pipeline("ds2")
+def _ds2_specs(mesh: Mesh, param_rules: Optional[Sequence] = None
+               ) -> SpecSet:
+    """DeepSpeech2 CTC training: length-bucketed batches dim-0 over
+    ``data`` (the (features, n_frames) input tuple is batch-major on
+    both legs); optional tensor-parallel weight rules on a data×model
+    mesh."""
+    return SpecSet(mesh, rules=param_rules)
+
+
+@register_pipeline("fraud")
+def _fraud_specs(mesh: Mesh) -> SpecSet:
+    """Fraud-detection MLP: pure data parallel."""
+    return SpecSet(mesh)

@@ -164,22 +164,34 @@ def partition_spec(path: str, shape: Tuple[int, ...], mesh: Mesh,
     return P()
 
 
+def spec_tree(tree: Any, mesh: Mesh,
+              rules: Optional[Sequence[Rule]] = None) -> Any:
+    """PartitionSpec for every leaf of ``tree``, structure-matched —
+    the declare-once form the spec layer (``parallel.specs``) registers
+    per pipeline.  Scalars and rule-misses resolve to replicated.
+    ``shard_tree`` is exactly ``device_put`` over this tree, so the
+    specs a pipeline declares and the placement it gets can't drift."""
+    rules = default_tp_rules() if rules is None else rules
+
+    def resolve(path_entries, leaf):
+        path = "/".join(str(getattr(e, "key", getattr(e, "name", e)))
+                        for e in path_entries)
+        arr = np.asarray(leaf) if not isinstance(leaf, jax.Array) else leaf
+        return (partition_spec(path, arr.shape, mesh, rules)
+                if getattr(arr, "ndim", 0) > 0 else P())
+
+    return jax.tree_util.tree_map_with_path(resolve, tree)
+
+
 def shard_tree(tree: Any, mesh: Mesh,
                rules: Optional[Sequence[Rule]] = None) -> Any:
     """device_put every leaf with its rule-resolved NamedSharding.  Works
     on a params dict or a whole TrainState (optimizer slots that mirror
     params pick up the same specs through their matching sub-paths)."""
-    rules = default_tp_rules() if rules is None else rules
-
-    def put(path_entries, leaf):
-        path = "/".join(str(getattr(e, "key", getattr(e, "name", e)))
-                        for e in path_entries)
-        arr = np.asarray(leaf) if not isinstance(leaf, jax.Array) else leaf
-        spec = (partition_spec(path, arr.shape, mesh, rules)
-                if getattr(arr, "ndim", 0) > 0 else P())
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
-
-    return jax.tree_util.tree_map_with_path(put, tree)
+    specs = spec_tree(tree, mesh, rules)
+    return jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        tree, specs)
 
 
 def sharded_param_count(tree: Any) -> int:

@@ -116,7 +116,10 @@ def make_train_step(
     module,
     criterion: Callable,
     optim: OptimMethod,
-    mesh=None,  # reserved for explicit in_shardings; batches arrive pre-sharded
+    mesh=None,  # legacy hint; pass specs= for annotated in/out shardings
+    specs=None,
+    state=None,
+    annotate_batches: bool = True,
     loss_scale: float = 1.0,
     grad_clip_norm: Optional[float] = None,
     skip_loss_above: Optional[float] = None,
@@ -129,6 +132,21 @@ def make_train_step(
     metric_fn: Optional[Callable] = None,
 ):
     """Build the jitted train step.
+
+    ``specs`` (optional, a :class:`~analytics_zoo_tpu.parallel.specs.
+    SpecSet`): the pipeline's declare-once sharding.  The step is then
+    jitted with explicit ``in_shardings``/``out_shardings`` — state and
+    metrics carry the declared NamedShardings, and (single-process, no
+    per-key batch overrides) HOST batches can be passed straight in:
+    jit itself places them dim-0 over the ``data`` axis, so no pipeline
+    calls ``device_put``/``shard_batch`` anywhere.  With tensor-parallel
+    rules armed, pass the concrete ``state`` too (per-leaf specs need
+    the tree structure).  Batch leaves must be batch-major arrays (the
+    ``shard_batch`` contract); for batches carrying 0-d leaves pass
+    ``annotate_batches=False`` (state/metrics keep their declared
+    shardings, batches arrive pre-placed by ``specs.place_batch``,
+    whose documented contract replicates scalars) — the Optimizer does
+    this automatically when it meets such a batch.
 
     ``metric_fn`` (optional): ``metric_fn(batch) → {name: scalar}``,
     fused into the compiled step and merged into the returned metrics —
@@ -321,6 +339,20 @@ def make_train_step(
         return new_state, metrics
 
     donate = (0,)
+    if specs is not None:
+        # declare-once substrate: the ONLY sharding source is the
+        # pipeline's SpecSet — state in/out carry its NamedShardings,
+        # batches ride the data-axis prefix (jit transfers host arrays
+        # itself on the single-process fast path), scalars (lr_scale,
+        # every metric) are replicated
+        state_sh = specs.state_shardings(state)
+        return jax.jit(
+            step_fn, donate_argnums=donate,
+            in_shardings=(state_sh,
+                          (specs.batch_shardings() if annotate_batches
+                           else None),
+                          specs.replicated),
+            out_shardings=(state_sh, specs.replicated))
     return jax.jit(step_fn, donate_argnums=donate)
 
 
@@ -333,11 +365,17 @@ def _set_lr(opt_state, lr):
     return opt_state
 
 
-def make_eval_step(module, compute_dtype=None):
+def make_eval_step(module, compute_dtype=None, specs=None):
     """Jitted inference step: ``outputs = eval_step(variables, inputs)``.
 
     ``compute_dtype='bf16'`` runs the forward in bfloat16 (serving-path
     mixed precision) with outputs cast back to fp32.
+
+    ``specs`` (a :class:`~analytics_zoo_tpu.parallel.specs.SpecSet`):
+    mesh-annotated serving — jit places the variables replicated and the
+    batch dim-0 over the ``data`` axis, so a serving forward scales out
+    by widening the mesh with no predictor code change (the same
+    declare-once substrate the train step consumes).
     """
 
     cdtype = resolve_compute_dtype(compute_dtype)
@@ -352,6 +390,15 @@ def make_eval_step(module, compute_dtype=None):
             out = cast_floating(out, jnp.float32)
         return out
 
+    if specs is not None:
+        # ragged tail batches (dim 0 not divisible by the data axis)
+        # run the un-annotated program — validation/predict sets keep
+        # their remainder batches; the routing rule lives in the spec
+        # layer so every annotated serving program shares it
+        return specs.ragged_dispatch(
+            jax.jit(eval_fn, in_shardings=(specs.replicated,
+                                           specs.batch_shardings())),
+            jax.jit(eval_fn))
     return jax.jit(eval_fn)
 
 
@@ -448,7 +495,9 @@ class Optimizer:
                  compute_dtype=None, device_transform=None,
                  param_rules=None, prefetch: int = 0,
                  grad_accum: int = 1, forward_fn=None,
-                 batch_overrides=None, metric_fn=None):
+                 batch_overrides=None, metric_fn=None, specs=None):
+        from analytics_zoo_tpu.parallel.specs import SpecSet
+
         self.model = model
         self.dataset = dataset
         self.criterion = criterion
@@ -456,7 +505,26 @@ class Optimizer:
         # jitted on-device batch rewrite (e.g. the device-augmentation
         # program, transform/vision/device.py) applied after sharding
         self.device_transform = device_transform
-        self.mesh = mesh or mesh_lib.create_mesh()
+        # the declare-once sharding substrate (parallel.specs): EVERY
+        # placement this loop performs — state replication/TP sharding,
+        # batch feeds, the step's jit in/out shardings — flows through
+        # one SpecSet.  `param_rules`/`batch_overrides` remain as sugar
+        # that BUILDS the SpecSet, so legacy callers land on the same
+        # single path.
+        if specs is not None:
+            if mesh is not None and mesh is not specs.mesh:
+                raise ValueError("pass mesh= OR specs= (the SpecSet "
+                                 "carries its mesh), not conflicting both")
+            if param_rules is not None or batch_overrides is not None:
+                raise ValueError("param_rules/batch_overrides are the "
+                                 "legacy sugar for building a SpecSet — "
+                                 "declare them inside specs= instead")
+            self.specs = specs
+        else:
+            self.specs = SpecSet(mesh or mesh_lib.create_mesh(),
+                                 rules=param_rules,
+                                 batch_overrides=batch_overrides)
+        self.mesh = self.specs.mesh
         self.optim: OptimMethod = Adam(1e-3)
         self.end_when: Trigger = Trigger.max_epoch(1)
         self.val_trigger: Optional[Trigger] = None
@@ -469,9 +537,8 @@ class Optimizer:
         self.val_summary = None
         self.skip_loss_above = skip_loss_above
         self.grad_clip_norm = grad_clip_norm
-        # tensor-parallel sharding rules (parallel.tensor); None = pure
-        # data-parallel replication
-        self.param_rules = param_rules
+        # views onto the SpecSet (back-compat attribute surface)
+        self.param_rules = self.specs.rules
         # > 0: shard+transfer batches on a background thread, staying
         # `prefetch` ahead of the device (data.prefetch double-buffering,
         # SURVEY.md §3.1 HOT LOOP #1 overlap)
@@ -486,8 +553,8 @@ class Optimizer:
         self.metric_fn = metric_fn
         # per-key PartitionSpec overrides for shard_batch, e.g.
         # {"input": tensor.spatial_input_spec()} for spatial TP
-        self.batch_overrides = batch_overrides
-        if batch_overrides and prefetch:
+        self.batch_overrides = self.specs.batch_overrides
+        if self.batch_overrides and prefetch:
             raise ValueError("batch_overrides is not supported with "
                              "prefetch (the prefetch path shards with "
                              "the default data-axis specs)")
@@ -646,18 +713,29 @@ class Optimizer:
         spike = self.skip_loss_above
         if anomaly_on and self.anomaly_policy.spike_loss_above is not None:
             spike = self.anomaly_policy.spike_loss_above
-        train_step = make_train_step(
-            self.model.module, self.criterion, self.optim,
-            mesh=self.mesh, skip_loss_above=spike,
-            grad_clip_norm=self.grad_clip_norm,
-            compute_dtype=self.compute_dtype,
-            grad_accum=self.grad_accum,
-            device_transform=self.device_transform,
-            forward_fn=self.forward_fn,
-            health_check=anomaly_on,
-            skip_unhealthy=anomaly_on and self.anomaly_policy.skip,
-            metric_fn=self.metric_fn,
-        )
+        def build_step(annotate_batches=True):
+            return make_train_step(
+                self.model.module, self.criterion, self.optim,
+                specs=self.specs, state=state,
+                annotate_batches=annotate_batches,
+                skip_loss_above=spike,
+                grad_clip_norm=self.grad_clip_norm,
+                compute_dtype=self.compute_dtype,
+                grad_accum=self.grad_accum,
+                device_transform=self.device_transform,
+                forward_fn=self.forward_fn,
+                health_check=anomaly_on,
+                skip_unhealthy=anomaly_on and self.anomaly_policy.skip,
+                metric_fn=self.metric_fn,
+            )
+
+        train_step = build_step()
+        # built lazily the first time a batch carries a 0-d leaf: the
+        # data-axis batch annotation cannot express "replicate this
+        # scalar", so such batches ride an un-annotated-batch variant
+        # of the SAME step, pre-placed by specs.place_batch (whose
+        # documented contract replicates scalars)
+        scalar_step = [None]
         if anomaly_on:
             from analytics_zoo_tpu.resilience.anomaly import (
                 AnomalySentinel, health_sections)
@@ -673,8 +751,18 @@ class Optimizer:
                 from analytics_zoo_tpu.parallel import checkpoint as ckpt
                 if ckpt.lkg_snapshot(self.checkpoint_path) is None:
                     self._promote_lkg(loop, state)
-        eval_step = make_eval_step(self.model.module,
-                                   compute_dtype=self.compute_dtype)
+        eval_step = make_eval_step(
+            self.model.module, compute_dtype=self.compute_dtype,
+            # validation rides the same substrate: replicated variables
+            # + data-axis batches via jit in_shardings.  A mesh spanning
+            # processes keeps the un-annotated path (host arrays cannot
+            # be jit-placed across processes), and tensor-parallel rules
+            # keep theirs (a replicated prefix would all-gather the
+            # sharded params every call).
+            specs=(self.specs
+                   if (self.specs.rules is None
+                       and not mesh_lib.spans_processes(self.mesh))
+                   else None))
         # telemetry spine: the tracer/StepTimer pair is None-checked on
         # the hot path so an un-instrumented loop pays nothing
         obs = self.obs
@@ -687,6 +775,15 @@ class Optimizer:
             step_timer = StepTimer("train/dispatch", registry=obs.registry)
         if self.prefetch:
             from analytics_zoo_tpu.data.prefetch import device_prefetch
+        # single-process, no per-key overrides: host batches go straight
+        # into the annotated jit (its in_shardings do the placement)
+        jit_places = self.specs.jit_places_batches()
+        batch_annotated = self.specs.batch_shardings() is not None
+
+        def _has_scalar_leaf(b):
+            return any(getattr(leaf, "ndim", 0) == 0
+                       for leaf in jax.tree_util.tree_leaves(b))
+
         ph = self.preemption_handler
         wd = self.stall_watchdog
         if ph is not None:
@@ -720,10 +817,28 @@ class Optimizer:
                 try:
                     for batch in epoch_iter:
                         n = _batch_size(batch)
-                        dev_batch = (batch if self.prefetch
-                                     else mesh_lib.shard_batch(
-                                         batch, self.mesh,
-                                         overrides=self.batch_overrides))
+                        # prefetch path: already sharded on the worker
+                        # thread.  jit fast path: the annotated step's
+                        # in_shardings place the HOST batch (one
+                        # transfer, no explicit device_put).  Otherwise
+                        # (per-key overrides, multi-process mesh) the
+                        # spec layer assembles the device batch.  A
+                        # batch with a 0-d leaf takes the lazily-built
+                        # un-annotated-batch step (the data-axis prefix
+                        # is invalid for rank-0; place_batch replicates
+                        # scalars, preserving the shard_batch contract).
+                        step_fn = train_step
+                        if batch_annotated and _has_scalar_leaf(batch):
+                            if scalar_step[0] is None:
+                                scalar_step[0] = build_step(
+                                    annotate_batches=False)
+                            step_fn = scalar_step[0]
+                            dev_batch = (batch if self.prefetch
+                                         else self.specs.place_batch(batch))
+                        else:
+                            dev_batch = (batch if (self.prefetch
+                                                   or jit_places)
+                                         else self.specs.place_batch(batch))
                         # device_transform is fused INSIDE train_step
                         step_span = None
                         if tracer is not None:
@@ -740,11 +855,11 @@ class Optimizer:
                                 batch=self._iter_in_epoch)
                         try:
                             if step_timer is None:
-                                state, metrics = train_step(
+                                state, metrics = step_fn(
                                     state, dev_batch, self.optim.lr_scale)
                             else:
                                 with step_timer.step(n):
-                                    state, metrics = train_step(
+                                    state, metrics = step_fn(
                                         state, dev_batch,
                                         self.optim.lr_scale)
                         except BaseException as e:
@@ -956,14 +1071,12 @@ class Optimizer:
                "back to the previous snapshot"))
 
     def _place_state(self, state: TrainState) -> TrainState:
-        """Host/state pytree → mesh placement: tensor-parallel sharding
-        rules when configured, else full replication.  The ONE placement
-        decision, shared by the initial `optimize()` setup and the
-        anomaly rollback restore so they can never drift."""
-        if self.param_rules is not None:
-            from analytics_zoo_tpu.parallel import tensor as tp
-            return tp.shard_tree(state, self.mesh, self.param_rules)
-        return mesh_lib.replicate(state, self.mesh)
+        """Host/state pytree → mesh placement through the declared
+        SpecSet (tensor-parallel rules when declared, else full
+        replication).  The ONE placement decision, shared by the initial
+        `optimize()` setup and the anomaly rollback restore so they can
+        never drift."""
+        return self.specs.place_state(state)
 
     # -- anomaly sentinel (resilience.anomaly ladder) ----------------------
     def _anomaly_step(self, loop: TrainingState, state: TrainState,

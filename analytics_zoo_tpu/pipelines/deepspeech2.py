@@ -337,7 +337,8 @@ def ds2_padding_metric(batch):
 
 def train_ds2(model: Model, dataset, epochs: int = 10, lr: float = 3e-4,
               mesh=None, checkpoint_path: Optional[str] = None,
-              param_rules=None, sequence_parallel: bool = False):
+              param_rules=None, sequence_parallel: bool = False,
+              specs=None):
     """CTC training for DS2 — capability the reference lacks (its DS2 is
     inference-only; SURVEY.md §2.3).  ``dataset`` yields batches
     ``{"input": (B,T,n_mels), "labels": (B,L) int32, "label_mask": (B,L)}``.
@@ -360,10 +361,23 @@ def train_ds2(model: Model, dataset, epochs: int = 10, lr: float = 3e-4,
     O(T/n) — long-audio CTC training beyond single-chip HBM.  The CTC
     loss itself consumes the (tiny, n_alphabet-wide) log-probs gathered
     back over T.
-    """
-    from analytics_zoo_tpu.parallel import Adam, Optimizer, Trigger, create_mesh
 
-    mesh = mesh or create_mesh()
+    Sharding is declared ONCE through the spec registry
+    (``specs=pipeline_specs("ds2", mesh=mesh, param_rules=...)``; built
+    here from ``mesh``/``param_rules`` when not given) and consumed by
+    the annotated train step — this entry point performs no device
+    placement, and a wider ``data`` axis is the global-batch lever of
+    docs/MFU_CEILING.md (per-chip batch × mesh width toward the B/128
+    occupancy knee).
+    """
+    from analytics_zoo_tpu.parallel import (Adam, Optimizer, Trigger,
+                                            pipeline_specs)
+
+    if specs is None:
+        specs = pipeline_specs("ds2", mesh=mesh, param_rules=param_rules)
+    elif mesh is not None or param_rules is not None:
+        raise ValueError("pass specs= OR (mesh=, param_rules=), not both")
+    mesh = specs.mesh
     criterion = ds2_ctc_criterion(blank_id=0)
 
     forward_fn = None
@@ -377,8 +391,8 @@ def train_ds2(model: Model, dataset, epochs: int = 10, lr: float = 3e-4,
             model.module, mesh,
             batch_axis="data" if "data" in mesh.axis_names else None)
 
-    opt = (Optimizer(model, dataset, criterion, mesh=mesh,
-                     param_rules=param_rules, forward_fn=forward_fn,
+    opt = (Optimizer(model, dataset, criterion, specs=specs,
+                     forward_fn=forward_fn,
                      metric_fn=ds2_padding_metric)
            .set_optim_method(Adam(lr))
            .set_end_when(Trigger.max_epoch(epochs)))
@@ -688,7 +702,8 @@ def load_asr_train_set(samples: np.ndarray, labels: np.ndarray,
 
 
 def ds2_serving_tiers(model: Model, param: Optional[DS2Param] = None,
-                      degraded_beam: Optional[int] = None) -> List:
+                      degraded_beam: Optional[int] = None,
+                      specs=None) -> List:
     """Degradation-ladder rungs for the online serving runtime
     (``serving.ServingRuntime``): prefix-beam width is DS2's analog of
     the SSD ladder's NMS top-K — the decode-side work that can be cut
@@ -707,13 +722,17 @@ def ds2_serving_tiers(model: Model, param: Optional[DS2Param] = None,
     reduced beam (``degraded_beam``, default ``max(4, width // 4)``),
     greedy best-path.  With ``param.decoder == "greedy"`` there is no
     decode quality to shed, so the ladder is the single greedy tier.
+
+    ``specs`` (e.g. ``pipeline_specs("ds2", mesh=mesh)``): the shared
+    forward is then mesh-annotated through the spec layer (variables
+    replicated, batch over ``data``).
     """
     from analytics_zoo_tpu.models.deepspeech2 import ds2_valid_out_frames
     from analytics_zoo_tpu.serving.ladder import ServingTier
     from analytics_zoo_tpu.transform.audio import beam_search_decode
 
     param = param or DS2Param()
-    eval_step = make_eval_step(model.module)
+    eval_step = make_eval_step(model.module, specs=specs)
 
     def forward_with(decode: Callable[[np.ndarray], str]):
         def forward(batch: Dict) -> List[str]:

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from analytics_zoo_tpu.core.criterion import ClassNLLCriterion
 from analytics_zoo_tpu.core.module import Model
 from analytics_zoo_tpu.models import FraudMLP
-from analytics_zoo_tpu.parallel import Adam, Optimizer, Trigger, create_mesh
+from analytics_zoo_tpu.parallel import Adam, Optimizer, Trigger, pipeline_specs
 from analytics_zoo_tpu.pipelines.frame import (
     Frame,
     FramePipeline,
@@ -66,12 +66,14 @@ class MLPClassifier(Stage):
     def fit(self, frame: Frame) -> "MLPClassifier":
         x = np.asarray(frame[self.features_col], np.float32)
         y = np.asarray(frame[self.label_col], np.int32)
-        mesh = self.mesh or create_mesh()
+        # sharding declared once through the spec registry; the
+        # annotated train step owns all placement
+        specs = pipeline_specs("fraud", mesh=self.mesh)
         model = Model(FraudMLP(in_features=self.in_features,
                                hidden=self.hidden, n_classes=self.n_classes))
         model.build(self.seed, jnp.zeros((1, x.shape[1])))
         batches = self._batches(x, y)
-        (Optimizer(model, batches, ClassNLLCriterion(), mesh=mesh)
+        (Optimizer(model, batches, ClassNLLCriterion(), specs=specs)
          .set_optim_method(Adam(self.lr))
          .set_end_when(Trigger.max_epoch(self.epochs))
          .optimize())

@@ -202,7 +202,8 @@ def train_frcnn(model, dataset, resolution: int, epochs: int = 10,
     """
     from analytics_zoo_tpu.ops.frcnn_train import (FrcnnLossParam,
                                                    frcnn_training_loss)
-    from analytics_zoo_tpu.parallel import Optimizer, SGD, Trigger, create_mesh
+    from analytics_zoo_tpu.parallel import (Optimizer, SGD, Trigger,
+                                            pipeline_specs)
 
     loss_param = loss_param or FrcnnLossParam()
     module = model.module
@@ -217,8 +218,10 @@ def train_frcnn(model, dataset, resolution: int, epochs: int = 10,
     def criterion(outputs, batch):
         return frcnn_training_loss(outputs, batch, loss_param)
 
+    # sharding declared once through the spec registry (data parallel;
+    # the annotated step owns all placement — no device_put here)
     opt = (Optimizer(model, frcnn_train_batches(dataset, resolution),
-                     criterion, mesh=mesh or create_mesh(),
+                     criterion, specs=pipeline_specs("frcnn", mesh=mesh),
                      forward_fn=forward_fn, grad_clip_norm=grad_clip_norm)
            .set_optim_method(SGD(lr, momentum=0.9, schedule=lr_schedule))
            .set_end_when(Trigger.max_epoch(epochs)))

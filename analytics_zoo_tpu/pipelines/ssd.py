@@ -47,7 +47,6 @@ from analytics_zoo_tpu.parallel import (
     TrainSummary,
     Trigger,
     ValidationSummary,
-    create_mesh,
     make_eval_step,
     multistep,
 )
@@ -350,13 +349,21 @@ class SSDPredictor:
     def __init__(self, model: Model, param: PreProcessParam,
                  post: Optional[DetectionOutputParam] = None,
                  n_classes: int = 21, compute_dtype=None,
-                 quantize=False):
+                 quantize=False, specs=None):
         """``quantize``: ``False`` (fp serving), ``True``/``"weight"``
         (int8 weights in HBM, fp math — bandwidth compression), or
         ``"int8"`` (real int8×int8→int32 convolutions on the MXU with
-        dynamic per-tensor activation quantization)."""
+        dynamic per-tensor activation quantization).
+
+        ``specs`` (:class:`~analytics_zoo_tpu.parallel.specs.SpecSet`):
+        serve over a sharded mesh — the jitted detect program is
+        annotated with the declared shardings (variables replicated,
+        batch dim-0 over ``data``), so widening the mesh widens serving
+        with no predictor change.  The predictor itself never calls
+        ``device_put``; placement lives in the spec layer only."""
         self.model = model
         self.param = param
+        self.specs = specs
         self.post = post or DetectionOutputParam(n_classes=n_classes)
         priors, variances = build_priors(
             ssd300_config() if param.resolution == 300 else ssd512_config())
@@ -387,6 +394,22 @@ class SSDPredictor:
         self.post = dataclasses.replace(self.post, keep_topk=k)
         return self
 
+    def _serving_jit(self, fn, static_argnums, n_batch_args: int):
+        """jit a serving program through the spec layer: with a declared
+        SpecSet the program carries in_shardings (variables replicated,
+        the ``n_batch_args`` leading batch-major array args dim-0 over
+        ``data``); batches whose dim 0 doesn't divide the data axis
+        (ragged predict tails) fall back to the un-annotated program.
+        No SpecSet → the legacy single-program jit."""
+        plain = jax.jit(fn, static_argnums=static_argnums)
+        if self.specs is None:
+            return plain
+        annotated = jax.jit(
+            fn, static_argnums=static_argnums,
+            in_shardings=(self.specs.replicated,)
+            + (self.specs.data_sharding,) * n_batch_args)
+        return self.specs.ragged_dispatch(annotated, plain)
+
     @functools.cached_property
     def _detect(self):
         """ONE jitted program for forward + softmax + DetectionOutput +
@@ -405,7 +428,8 @@ class SSDPredictor:
                 inputs = inputs.astype(jnp.float32) - means
             return tail(variables, inputs, h, w, post)
 
-        return jax.jit(detect, static_argnums=(4,))
+        return self._serving_jit(detect, static_argnums=(4,),
+                                 n_batch_args=3)
 
     @property
     def _forward_tail(self):
@@ -439,7 +463,8 @@ class SSDPredictor:
             return tail(variables, yuv420_to_bgr_device(y, uv) - means,
                         h, w, post)
 
-        return jax.jit(detect, static_argnums=(5,))
+        return self._serving_jit(detect, static_argnums=(5,),
+                                 n_batch_args=4)
 
     def detect_normalized(self, inputs) -> jnp.ndarray:
         """Forward + softmax + DetectionOutput → (B, K, 6) normalized-box
@@ -709,14 +734,27 @@ class TrainParams:
 
 def train_ssd(train_set, val_set, params: TrainParams,
               model: Optional[Model] = None, mesh=None,
-              device_transform: Optional[Callable] = None) -> Model:
+              device_transform: Optional[Callable] = None,
+              tp: Optional[str] = None) -> Model:
     """The Train entry point's optimize() assembly (reference
     ``Train.scala:150-252``).
 
     ``device_transform``: the jitted augment returned by
     ``load_train_set_device`` — fuses the on-device augmentation into
-    every compiled train step (pass the matching staged ``train_set``)."""
-    mesh = mesh or create_mesh()
+    every compiled train step (pass the matching staged ``train_set``).
+
+    Sharding is declared ONCE through the spec registry
+    (``pipeline_specs("ssd", ...)``) and consumed by the Optimizer's
+    annotated jit — this entry point performs no device placement.
+    ``tp``: ``None`` (data parallel) | ``"spatial"`` (image height over
+    the ``model`` axis) | ``"megatron"`` (paired col/row weight
+    sharding); parallelism modes compose by changing the MESH SHAPE
+    (e.g. ``create_mesh((2, 4), axis_names=("data", "model"))``), not
+    this function."""
+    from analytics_zoo_tpu.parallel import pipeline_specs
+
+    specs = pipeline_specs("ssd", mesh=mesh, tp=tp,
+                           resolution=params.resolution)
     cfg = (ssd300_config() if params.resolution == 300 else ssd512_config())
     priors, variances = build_priors(cfg)
     criterion = MultiBoxLoss(priors, variances,
@@ -730,7 +768,7 @@ def train_ssd(train_set, val_set, params: TrainParams,
                                         resolution=params.resolution)
 
     def make_optimizer(optim_method, end_when):
-        opt = (Optimizer(model, train_set, criterion, mesh=mesh,
+        opt = (Optimizer(model, train_set, criterion, specs=specs,
                          skip_loss_above=50.0,
                          compute_dtype=params.compute_dtype,
                          prefetch=params.prefetch,
@@ -774,7 +812,7 @@ def train_ssd(train_set, val_set, params: TrainParams,
 def ssd_serving_tiers(model: Model, param: PreProcessParam,
                       post: Optional[DetectionOutputParam] = None,
                       n_classes: int = 21, compute_dtype=None,
-                      degraded_topk: int = 50) -> List:
+                      degraded_topk: int = 50, specs=None) -> List:
     """Degradation-ladder rungs for the online serving runtime
     (``serving.ServingRuntime``): three :class:`~analytics_zoo_tpu.
     serving.ladder.ServingTier` s over the SAME ``SSDPredictor`` serving
@@ -794,15 +832,22 @@ def ssd_serving_tiers(model: Model, param: PreProcessParam,
     the batch axis to ``max_batch``.  ``speed`` values are relative
     service-time hints for the batcher's flush heuristic, from the
     banked int8 conv reading — the EWMA refines them online.
+
+    ``specs`` (:class:`~analytics_zoo_tpu.parallel.specs.SpecSet`, e.g.
+    ``pipeline_specs("ssd", mesh=mesh)``): every tier's detect program
+    is then mesh-annotated (variables replicated, batch over ``data``)
+    — serving scales out by widening the mesh, with the spec layer as
+    the only placement site.
     """
     import copy
 
     from analytics_zoo_tpu.serving.ladder import ServingTier
 
     full = SSDPredictor(model, param, post=post, n_classes=n_classes,
-                        compute_dtype=compute_dtype)
+                        compute_dtype=compute_dtype, specs=specs)
     int8 = SSDPredictor(model, param, post=post, n_classes=n_classes,
-                        compute_dtype=compute_dtype, quantize=True)
+                        compute_dtype=compute_dtype, quantize=True,
+                        specs=specs)
     # tier 2 shares tier 1's quantized variables (no second quantize
     # pass); only the DetectionOutput param differs — `post` is a static
     # jit argument, so the shared program specializes per tier

@@ -74,6 +74,14 @@ class ServingRuntime:
     ``chaos``: an armed :class:`~analytics_zoo_tpu.resilience.chaos.
     ChaosMonkey` whose serving-kind windows (``slow_forward``,
     ``replica_crash``) are applied per dispatch index.
+
+    ``specs``: the pipeline's declared
+    :class:`~analytics_zoo_tpu.parallel.specs.SpecSet` — pass the SAME
+    object the tiers were built with (``ssd_serving_tiers(specs=...)``
+    / ``ds2_serving_tiers(specs=...)``), so train and serve share ONE
+    sharding declaration.  The runtime itself never places arrays (the
+    tiers' annotated forwards do); it records the mesh topology in
+    ``snapshot()`` so a banked drill names the serving geometry.
     """
 
     def __init__(self, tiers: Sequence[ServingTier], n_replicas: int = 2,
@@ -91,10 +99,11 @@ class ServingRuntime:
                  ladder_policy: Optional[LadderPolicy] = None,
                  decision_every: int = 8,
                  shed_expired: bool = True,
-                 chaos=None, obs=None):
+                 chaos=None, obs=None, specs=None):
         if not tiers:
             raise ValueError("need at least one ServingTier")
         self.tiers = list(tiers)
+        self.specs = specs
         self.clock = clock or MonotonicClock()
         self.default_deadline_s = float(default_deadline_s)
         self.max_batch = int(max_batch)
@@ -349,7 +358,14 @@ class ServingRuntime:
                 "unaccounted": len(self.requests) - terminal}
 
     def snapshot(self) -> Dict[str, Any]:
+        mesh_info = None
+        if self.specs is not None:
+            mesh_info = {
+                "axes": dict(self.specs.mesh.shape),
+                "data_axis_size": self.specs.data_axis_size,
+            }
         return {
+            "mesh": mesh_info,
             "metrics": self.metrics.snapshot(),
             "queue": self.queue.snapshot(),
             "replicas": self.pool.snapshot(),

@@ -14,6 +14,98 @@ import sys
 
 import pytest
 
+#: minimal 2-process capability probe: some jaxlib CPU backends register
+#: the distributed runtime but cannot EXECUTE cross-process computations
+#: ("Multiprocess computations aren't implemented on the CPU backend").
+#: That is an environment limit, not a framework bug — the tests below
+#: must SKIP with a clear reason there, not fail tier-1.
+_PROBE_CHILD = r"""
+import os, sys
+import numpy as np
+
+sys.path.insert(0, os.environ["AZ_REPO"])
+
+from analytics_zoo_tpu.utils import engine
+
+pid = int(os.environ["AZ_PROC_ID"])
+engine.init(engine.EngineConfig(
+    coordinator_address=os.environ["AZ_COORD"],
+    num_processes=2, process_id=pid))
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from analytics_zoo_tpu.parallel import mesh as mesh_lib
+
+mesh = mesh_lib.create_mesh()
+garr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("data")),
+    np.ones((2, 1), np.float32), (4, 1))
+val = float(jax.jit(jnp.sum)(garr))
+assert val == 4.0, val
+print("MULTIPROC_PROBE_OK")
+"""
+
+_probe_cache = None
+
+
+def _multiprocess_cpu_support():
+    """(supported, reason) — cached per session.  Spawns two 1-device
+    CPU processes and runs one cross-process reduction; a backend that
+    cannot execute multiprocess computations yields the skip reason."""
+    global _probe_cache
+    if _probe_cache is not None:
+        return _probe_cache
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        env["AZ_REPO"] = repo
+        env["AZ_COORD"] = f"localhost:{port}"
+        env["AZ_PROC_ID"] = str(pid)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _PROBE_CHILD], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            out = "(probe timed out)"
+        outs.append(out)
+    joined = "\n".join(outs)
+    if all(p.returncode == 0 for p in procs) \
+            and joined.count("MULTIPROC_PROBE_OK") == 2:
+        _probe_cache = (True, "")
+    elif "aren't implemented on the CPU backend" in joined:
+        _probe_cache = (False,
+                        "this jaxlib's CPU backend cannot execute "
+                        "multiprocess computations (probe: 'Multiprocess "
+                        "computations aren't implemented on the CPU "
+                        "backend') — multi-host coverage needs a "
+                        "collectives-capable backend")
+    else:
+        # an UNRECOGNIZED probe failure must not silently skip the
+        # suite: let the real tests run and show the real error
+        _probe_cache = (True, "")
+    return _probe_cache
+
+
+def _require_multiprocess_cpu():
+    supported, reason = _multiprocess_cpu_support()
+    if not supported:
+        pytest.skip(reason)
+
+
 _CHILD = r"""
 import os, sys
 import numpy as np
@@ -232,6 +324,7 @@ def test_four_process_train_then_elastic_resume_as_two(tmp_path):
     devices to epoch 6; final parameters must match a single-process
     8-device run of all 6 epochs (repartitioning is a layout change, not
     a math change)."""
+    _require_multiprocess_cpu()
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ckpt = str(tmp_path / "ckpt")
 
@@ -277,6 +370,7 @@ def test_four_process_train_then_elastic_resume_as_two(tmp_path):
 
 
 def test_two_process_distributed_init(tmp_path):
+    _require_multiprocess_cpu()
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     with socket.socket() as s:
         s.bind(("localhost", 0))
@@ -319,6 +413,7 @@ def test_two_process_optimizer_matches_single_process(tmp_path):
     the final parameters match a single-process run on the same global
     batches to float tolerance (data-parallel partitioning is a layout
     change, not a math change)."""
+    _require_multiprocess_cpu()
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     with socket.socket() as s:
         s.bind(("localhost", 0))

@@ -155,7 +155,10 @@ class TestFrcnnTrainStep:
         from analytics_zoo_tpu.parallel import create_mesh
 
         loss0 = eval_loss(model)
-        train_frcnn(model, batches, RES, epochs=4, lr=3e-3,
+        # 2 epochs: compile dominates this test's wall time; the loss
+        # drop from an untrained net shows within 4 steps (tier-1
+        # budget, ISSUE 9)
+        train_frcnn(model, batches, RES, epochs=2, lr=3e-3,
                     mesh=create_mesh((2,), axis_names=("data",),
                                      devices=jax.devices()[:2]))
         loss1 = eval_loss(model)

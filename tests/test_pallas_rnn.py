@@ -40,7 +40,12 @@ CELLS = [
 ]
 
 
-def _x_for(name, key=RNG, B=3, T=11):
+def _x_for(name, key=RNG, B=3, T=7):
+    # T=7: still exercises time-block padding (pads to the 8-step time
+    # block) and multi-block blocked scans (block_size=4 → 2 blocks),
+    # at ~60% of the T=11 interpret-mode wall time the r7 suite paid
+    # (the tier-1 budget satellite of ISSUE 9) — coverage-equivalent,
+    # cheaper geometry
     D = 5 if name == "rnn_identity" else 4  # identity i2h: D == hidden
     return jax.random.normal(key, (B, T, D))
 
@@ -66,7 +71,7 @@ class TestEngineEquivalence:
         """The ISSUE-6 acceptance gate: ≤1e-5 fwd+grad vs the blocked
         scan, uniform and masked ragged batches."""
         x = _x_for(name)
-        n = jnp.array([11, 7, 3], jnp.int32) if masked else None
+        n = jnp.array([7, 5, 2], jnp.int32) if masked else None
         blocked = Recurrent(cell=make(), block_size=4)
         pallas = Recurrent(cell=make(), engine="pallas")
         v = blocked.init(RNG, x)
@@ -96,7 +101,7 @@ class TestEngineEquivalence:
         BiRecurrent needs (valid frames reverse in place, padding
         untouched)."""
         x = _x_for(name)
-        n = jnp.array([11, 7, 3], jnp.int32)
+        n = jnp.array([7, 5, 2], jnp.int32)
         blocked = Recurrent(cell=make(), block_size=4, reverse=True)
         pallas = Recurrent(cell=make(), engine="pallas", reverse=True)
         v = blocked.init(RNG, x)
@@ -109,7 +114,7 @@ class TestEngineEquivalence:
         ragged rows equal their own unpadded forwards (the padded-
         reverse defect must stay fixed on the kernel path too)."""
         x = _x_for("rnn")
-        n = np.array([11, 7, 3], np.int32)
+        n = np.array([7, 5, 2], np.int32)
         bi = BiRecurrent(cell=RnnCell(hidden_size=6), merge="sum",
                          engine="pallas")
         v = bi.init(RNG, x)
@@ -157,20 +162,20 @@ class TestEngineEquivalence:
         net = Recurrent(cell=RnnCell(hidden_size=6), reverse=True,
                         engine=engine, block_size=4)
         v = net.init(RNG, x)
-        y_over = net.apply(v, x, n_frames=jnp.array([13, 7, 3]))
-        y_full = net.apply(v, x, n_frames=jnp.array([11, 7, 3]))
+        y_over = net.apply(v, x, n_frames=jnp.array([9, 5, 2]))
+        y_full = net.apply(v, x, n_frames=jnp.array([7, 5, 2]))
         assert np.isfinite(np.asarray(y_over)).all()
         np.testing.assert_allclose(np.asarray(y_over), np.asarray(y_full),
                                    atol=1e-6)
 
     def test_masked_carry_freezes_at_true_length(self):
         cell = GRUCell(hidden_size=5)
-        x = _x_for("gru", B=2, T=11)
-        n = np.array([11, 6], np.int32)
+        x = _x_for("gru", B=2, T=7)
+        n = np.array([7, 4], np.int32)
         net = Recurrent(cell=cell, engine="pallas")
         v = net.init(RNG, x)
         _, c = net.apply(v, x, n_frames=jnp.asarray(n), return_carry=True)
-        _, c_short = net.apply(v, x[1:2, :6], return_carry=True)
+        _, c_short = net.apply(v, x[1:2, :4], return_carry=True)
         np.testing.assert_allclose(np.asarray(c[1:2]),
                                    np.asarray(c_short), atol=1e-5)
 

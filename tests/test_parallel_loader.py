@@ -277,3 +277,28 @@ def test_asr_train_set_parallel(tmp_path):
         np.testing.assert_array_equal(a["input"], b["input"])
         np.testing.assert_array_equal(a["labels"], b["labels"])
         np.testing.assert_array_equal(a["label_mask"], b["label_mask"])
+
+
+def test_start_epoch_resume_replays_interrupted_epoch_stream():
+    """Resume contract (ISSUE 9 preemption drill): a FRESH loader built
+    with ``start_epoch=N`` over a freshly-constructed per-epoch-shuffling
+    source must yield byte-identically the stream epoch N of an
+    uninterrupted loader produced — both the seeding keys AND the
+    source's own reshuffle closure must land on the epoch-N coordinate
+    (the latter silently stayed at epoch 0 before the fix)."""
+
+    def fresh():
+        return (DataSet.from_arrays(shuffle=True, seed=3,
+                                    x=np.arange(96, dtype=np.float32)
+                                    .reshape(24, 4))
+                .batch(4))
+
+    for workers in (0, 2):
+        full = fresh().parallel(workers, base_seed=7)
+        _ = list(full)                       # epoch 0 consumed
+        epoch1_ref = list(full)              # the "interrupted" epoch
+        resumed = fresh().parallel(workers, base_seed=7, start_epoch=1)
+        epoch1_resumed = list(resumed)
+        assert len(epoch1_ref) == len(epoch1_resumed) == 6
+        for a, b in zip(epoch1_ref, epoch1_resumed):
+            np.testing.assert_array_equal(a["x"], b["x"])

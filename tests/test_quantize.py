@@ -292,9 +292,17 @@ class TestInt8Compute:
         out = np.asarray(make_quantized_forward(m, compute="int8")(q, x))
         assert np.abs(out - ref).max() < 0.1 * (np.abs(ref).max() + 1e-6)
 
+    @pytest.mark.slow
     def test_ssd_predictor_int8_compute(self):
         """SSDPredictor(quantize="int8") end-to-end on records: output
-        structure intact, scores close to fp on an untrained net."""
+        structure intact, scores close to fp on an untrained net.
+
+        Slow lane (ISSUE 9 tier-1 budget): this single test compiled
+        TWO full SSD300 programs (fp + int8-intercepted) for ~280 s of
+        the 870 s budget.  The int8-compute mechanism itself stays in
+        tier-1 through the dense/conv-geometry/exactness/fallback parity
+        tests above — only this end-to-end SSD assurance pass rides the
+        slow lane."""
         import cv2
 
         from analytics_zoo_tpu.core.module import Model

@@ -372,6 +372,44 @@ class TestCheckArtifacts:
         (tmp_path / "RESILIENCE_r01.json").write_text("{broken")
         assert len(ca.check_artifacts(str(tmp_path))) == 1
 
+    def test_issue9_artifacts_are_stamped_not_grandfathered(self):
+        """ISSUE 9 satellite: the new BENCH_r08 / MULTICHIP_r06 bankings
+        are covered by the lint as STAMPED artifacts — the LEGACY set
+        stayed closed (adding them there would have silently waived the
+        metadata requirement)."""
+        import json
+
+        from tools.check_artifacts import LEGACY, PATTERN, REQUIRED_KEYS
+
+        root = os.path.join(os.path.dirname(__file__), os.pardir)
+        for name in ("BENCH_r08.json", "MULTICHIP_r06.json"):
+            assert PATTERN.match(name), name
+            assert name not in LEGACY, f"{name} must not be grandfathered"
+            doc = json.load(open(os.path.join(root, name)))
+            meta = doc["run_metadata"]
+            assert all(k in meta for k in REQUIRED_KEYS), name
+
+    def test_committed_multichip_r06_banks_sweeps_and_drill(self):
+        """The r06 artifact's own claims hold: both model sweeps have a
+        reading per device count with per-window values, and the
+        preemption drill resumed to a bit-exact fingerprint from a
+        MID-EPOCH checkpoint coordinate."""
+        import json
+
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "MULTICHIP_r06.json")
+        doc = json.load(open(path))
+        assert doc["virtual"] is True           # labeled honestly
+        for model in ("ssd", "ds2"):
+            sweep = doc["sweeps"][model]
+            assert [r["n"] for r in sweep] == doc["devices"]
+            assert all(len(r["windows"]) >= 2 for r in sweep)
+        drill = doc["drill"]
+        assert drill["ok"] is True
+        assert drill["fingerprint_match_bitexact"] is True
+        assert drill["loader_coordinates"]["mid_epoch"] is True
+        assert drill["resume"]["steps"] == drill["reference"]["steps"]
+
 
 class TestProfileMfuRnnAb:
     def test_rnn_ab_smoke_writes_h2h_share_artifact(self, tmp_path):
@@ -401,3 +439,28 @@ class TestProfileMfuRnnAb:
                 == pytest.approx(
                     h2h["intensity_blocked_flops_per_byte"] * 8))
         assert h2h["v5e_ridge_flops_per_byte"] == 240
+
+
+class TestBenchScalingDrill:
+    """Slow-lane live smoke of the ISSUE-9 scaling harness (the
+    committed MULTICHIP_r06.json pins the banked run in tier-1; this
+    re-executes the preemption-resume machinery end to end)."""
+
+    @pytest.mark.slow
+    def test_preemption_resume_drill_bitexact(self):
+        import json
+        import subprocess
+        import sys
+
+        repo = os.path.join(os.path.dirname(__file__), os.pardir)
+        out = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "bench_scaling.py"),
+             "--devices", "2", "--virtual", "--drill", "--models", "ssd",
+             "--steps", "1", "--windows", "1", "--batch-per-chip", "1",
+             "--sweep-log", ""],
+            capture_output=True, text=True, cwd=repo, timeout=900)
+        assert out.returncode == 0, out.stderr[-800:]
+        drill = [json.loads(ln) for ln in out.stdout.splitlines()
+                 if ln.startswith('{"drill"')][-1]["drill"]
+        assert drill["ok"] is True
+        assert drill["fingerprint_match_bitexact"] is True

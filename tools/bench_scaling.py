@@ -1,26 +1,39 @@
-"""Scaling-efficiency sweep: SSD300 sharded train step over 1..N devices.
+"""Scaling-efficiency sweep + preemption drill over the spec substrate.
 
-BASELINE.json's third metric is "8→64-chip scaling efficiency ≥60%".  This
-harness measures weak scaling (fixed per-chip batch): for each device
-count it runs the same pjit'd train step the real pipeline uses —
-batches sharded over the mesh's ``data`` axis, parameters replicated,
-gradient mean compiled to an all-reduce — and reports
-``efficiency(n) = throughput(n) / (n · throughput(1))``.
+BASELINE.json's third metric is "8→64-chip scaling efficiency ≥60%".
+This harness measures weak scaling (fixed per-chip batch) for the TWO
+flagship training pipelines — SSD300 and length-bucketed DS2 — each
+through exactly the program the real pipeline uses: sharding declared
+once via ``pipeline_specs(...)`` (parallel/specs.py), the annotated
+train step placing HOST batches itself, gradient mean compiled to an
+all-reduce.  ``efficiency(n) = throughput(n) / (n · throughput(1))``,
+with per-window values kept per device count (the drift policy of
+``bench.py``'s interleaved phases, applied per mesh size).
+
+``--drill`` adds the chaos leg ISSUE 9 banks: on the widest mesh, a
+host preemption (real SIGTERM mid-epoch through the multiprocess
+loader) forces the boundary checkpoint and raises ``Preempted``; a
+fresh process resumes from the atomic snapshot and must land on
+byte-equal final parameters vs an uninterrupted reference run — which
+is only possible if the loader's deterministic coordinates
+``(base_seed, epoch, batch index)`` survived the round trip.
 
 On real TPU slices the numbers are the metric.  Without enough real
-chips, pass ``--virtual`` to emulate the mesh with
+chips, pass ``--virtual`` to emulate each mesh with
 ``--xla_force_host_platform_device_count`` on CPU: that validates the
-mechanism (sharding, collectives, program correctness at each mesh size)
-but NOT performance — virtual devices share the host's cores, so
-efficiency trends toward 1/n by construction and the output is labeled
-``"virtual": true``.
+mechanism (sharding, collectives, program correctness at each mesh
+size) but NOT performance — virtual devices share the host's cores, so
+efficiency trends toward 1/n by construction and every line is labeled
+``"virtual": true`` (the MULTICHIP_r0* convention).
 
 Each device count runs in a fresh subprocess because XLA fixes the
-device count at backend init.
+device count at backend init.  Every emitted sweep line also appends to
+``bench_artifacts/BENCH_sweeps.jsonl`` like the bench.py phases.
 
 Usage::
 
-    python tools/bench_scaling.py --devices 1 2 4 8 --virtual
+    python tools/bench_scaling.py --devices 1 2 4 8 --virtual \
+        --models ssd ds2 --drill --emit MULTICHIP_r06.json
 """
 
 from __future__ import annotations
@@ -32,38 +45,60 @@ import subprocess
 import sys
 
 _CHILD_FLAG = "--_child"
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)       # the parent stamps obs.run_metadata
+
+#: drill geometry (shared by all three drill legs so their streams are
+#: byte-identical): fraud MLP, 256 records, batch 16 -> 16 batches/epoch
+_DRILL = dict(n_records=256, batch=16, epochs=4, workers=2,
+              base_seed=7, lr=0.1)
 
 
-def child(n: int, batch_per_chip: int, steps: int, res: int) -> None:
+def _append_sweep_log(path: str, line: dict) -> None:
+    if not path:
+        return
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(line) + "\n")
+    except OSError:
+        pass                          # the log is a convenience, never fatal
+
+
+# ---------------------------------------------------------------------------
+# sweep children (one process per device count; XLA pins the count at init)
+# ---------------------------------------------------------------------------
+
+
+def child_ssd(n: int, batch_per_chip: int, steps: int, res: int,
+              windows: int) -> None:
     import time
 
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from analytics_zoo_tpu.core.module import Model
     from analytics_zoo_tpu.models import SSDVgg, build_priors, ssd300_config
     from analytics_zoo_tpu.ops import MultiBoxLoss, MultiBoxLossParam
-    from analytics_zoo_tpu.parallel import (SGD, create_mesh,
-                                            create_train_state,
-                                            make_train_step, replicate,
-                                            shard_batch)
+    from analytics_zoo_tpu.parallel import (SGD, create_train_state,
+                                            make_train_step, pipeline_specs)
 
     assert jax.device_count() == n, (jax.device_count(), n)
-    mesh = create_mesh()
+    specs = pipeline_specs("ssd", resolution=res)     # declared once
     model = Model(SSDVgg(num_classes=21, resolution=res))
     model.build(0, jnp.zeros((1, res, res, 3), jnp.float32))
     priors, variances = build_priors(ssd300_config())
     criterion = MultiBoxLoss(priors, variances, MultiBoxLossParam())
     optim = SGD(1e-3, momentum=0.9)
-    state = replicate(create_train_state(model, optim), mesh)
-    step = make_train_step(model.module, criterion, optim, mesh=mesh,
+    state = specs.place_state(create_train_state(model, optim))
+    step = make_train_step(model.module, criterion, optim, specs=specs,
                            compute_dtype="bf16")
-
-    import numpy as np
 
     b = batch_per_chip * n
     rng = np.random.RandomState(0)
-    batch = shard_batch({
+    # HOST batch on purpose: the annotated jit's in_shardings place it
+    batch = {
         "input": rng.rand(b, res, res, 3).astype(np.float32),
         "target": {
             "bboxes": np.tile(np.asarray([0.1, 0.1, 0.6, 0.6], np.float32),
@@ -71,67 +106,407 @@ def child(n: int, batch_per_chip: int, steps: int, res: int) -> None:
             "labels": rng.randint(1, 21, (b, 8)).astype(np.int32),
             "mask": np.ones((b, 8), np.float32),
         },
-    }, mesh)
+    }
 
     state, m = step(state, batch, 1.0)                 # compile
     jax.block_until_ready(m["loss"])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, m = step(state, batch, 1.0)
-    jax.block_until_ready(m["loss"])
-    dt = time.perf_counter() - t0
-    print(json.dumps({"n": n, "images_per_sec": b * steps / dt,
+    rates = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step(state, batch, 1.0)
+        jax.block_until_ready(m["loss"])
+        rates.append(b * steps / (time.perf_counter() - t0))
+    rates.sort()
+    print(json.dumps({"model": "ssd", "n": n,
+                      "images_per_sec": rates[len(rates) // 2],
+                      "windows": [round(r, 3) for r in rates],
+                      "global_batch": b,
                       "loss": float(m["loss"])}))
+
+
+def child_ds2(n: int, batch_per_chip: int, steps: int, windows: int,
+              hidden: int, layers: int, seconds: int) -> None:
+    import time
+
+    import jax
+    import numpy as np
+
+    from analytics_zoo_tpu.data.bucket import BucketBatcher
+    from analytics_zoo_tpu.parallel import (Adam, create_train_state,
+                                            make_train_step, pipeline_specs)
+    from analytics_zoo_tpu.pipelines.deepspeech2 import (ds2_ctc_criterion,
+                                                         make_ds2_model)
+    from analytics_zoo_tpu.transform.audio.featurize import (WINDOW_SIZE,
+                                                             WINDOW_STRIDE)
+
+    assert jax.device_count() == n, (jax.device_count(), n)
+    n_max = (16000 * seconds - WINDOW_SIZE) // WINDOW_STRIDE + 1
+    B = batch_per_chip * n
+    n_records = B * 4
+    rng = np.random.RandomState(42)
+    frac = np.clip(rng.lognormal(-1.3, 0.7, n_records), 0.08, 1.0)
+    lengths = np.clip((frac * n_max).astype(np.int32), 16, n_max)
+    feats = [rng.randn(int(ln), 13).astype(np.float32) * 0.1
+             for ln in lengths]
+    labels = rng.randint(1, 29, (n_records, 20)).astype(np.int32)
+    # edges derived from the distribution, NOT the draw, so every mesh
+    # width shares the same compiled bucket geometries
+    edges = sorted({n_max // 8, n_max // 4, n_max // 2, n_max})
+
+    def stream():
+        for i in range(n_records):
+            yield {"input": feats[i], "n_frames": np.int32(lengths[i]),
+                   "labels": labels[i],
+                   "label_mask": np.ones((20,), np.float32)}
+
+    batches = []
+    for bb in BucketBatcher(B, edges).apply_iter(stream()):
+        batches.append({"input": (bb["input"], bb["n_frames"]),
+                        "n_frames": bb["n_frames"],
+                        "labels": bb["labels"],
+                        "label_mask": bb["label_mask"]})
+    recs = sum(bb["n_frames"].shape[0] for bb in batches)
+
+    specs = pipeline_specs("ds2")                     # declared once
+    model = make_ds2_model(hidden=hidden, n_rnn_layers=layers,
+                           utt_length=n_max)
+    optim = Adam(3e-4)
+    state = specs.place_state(create_train_state(model, optim))
+    step = make_train_step(model.module, ds2_ctc_criterion(), optim,
+                           specs=specs, compute_dtype="fp32")
+    for bb in batches:                                # compile per bucket
+        state, m = step(state, bb, 1.0)
+    float(np.asarray(m["loss"]))
+    reps = max(1, steps // max(len(batches), 1))
+    rates = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            for bb in batches:
+                state, m = step(state, bb, 1.0)
+        float(np.asarray(m["loss"]))
+        rates.append(recs * reps / (time.perf_counter() - t0))
+    rates.sort()
+    print(json.dumps({"model": "ds2", "n": n,
+                      "records_per_sec": rates[len(rates) // 2],
+                      "windows": [round(r, 3) for r in rates],
+                      "global_batch": B, "bucket_edges": edges,
+                      "records": recs,
+                      "loss": float(np.asarray(m["loss"]))}))
+
+
+# ---------------------------------------------------------------------------
+# preemption-resume drill children
+# ---------------------------------------------------------------------------
+
+
+class _SigtermAt:
+    """Wrap the batched dataset; deliver a REAL SIGTERM to this process
+    just before yielding global batch ``at`` (counted across epochs) —
+    the host-preemption notice, trapped by the PreemptionHandler."""
+
+    def __init__(self, inner, at):
+        self.inner = inner
+        self.at = at
+        self._count = 0
+
+    def __getattr__(self, name):          # loader attrs (base_seed, ...)
+        return getattr(self.inner, name)
+
+    def __iter__(self):
+        import signal
+
+        for batch in self.inner:
+            if self.at is not None and self._count == self.at:
+                os.kill(os.getpid(), signal.SIGTERM)
+            self._count += 1
+            yield batch
+
+
+def drill_child(mode: str, ckpt: str, preempt_at: int) -> None:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.core.criterion import ClassNLLCriterion
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.data import DataSet
+    from analytics_zoo_tpu.models.simple import FraudMLP
+    from analytics_zoo_tpu.parallel import (SGD, Optimizer, Trigger,
+                                            pipeline_specs)
+    from analytics_zoo_tpu.resilience.errors import Preempted
+
+    cfg = _DRILL
+    rng = np.random.RandomState(0)
+    x = rng.randn(cfg["n_records"], 29).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.int32)
+    # the PR-2 deterministic multiprocess loader: byte-identical stream
+    # for any worker count, coordinates (base_seed, epoch, batch index).
+    # A RESUMED process rebuilds the loader AT the checkpointed epoch
+    # (start_epoch) — the per-epoch shuffle then replays the exact
+    # stream the interrupted run was consuming.
+    start_epoch, resume_meta = 0, None
+    if mode == "resume":
+        from analytics_zoo_tpu.parallel import checkpoint as ckpt_lib
+
+        _, man = ckpt_lib.newest_intact(ckpt)
+        resume_meta = {k: man["meta"][k] for k in
+                       ("epoch", "iteration", "iter_in_epoch")}
+        start_epoch = int(resume_meta["epoch"])
+    dataset = (DataSet.from_arrays(shuffle=True, seed=3, input=x, target=y)
+               .batch(cfg["batch"])
+               .parallel(cfg["workers"], base_seed=cfg["base_seed"],
+                         start_epoch=start_epoch))
+    if mode == "preempt":
+        dataset = _SigtermAt(dataset, preempt_at)
+
+    specs = pipeline_specs("fraud")
+    model = Model(FraudMLP(in_features=29, hidden=10, n_classes=2))
+    model.build(0, jnp.zeros((1, 29), jnp.float32))
+    opt = (Optimizer(model, dataset, ClassNLLCriterion(), specs=specs)
+           .set_optim_method(SGD(cfg["lr"], momentum=0.9))
+           .set_end_when(Trigger.max_epoch(cfg["epochs"])))
+    if mode in ("preempt", "resume"):
+        opt.set_checkpoint(ckpt, Trigger.every_epoch())
+    if mode == "preempt":
+        opt.set_preemption_handler()
+    if mode == "resume":
+        opt.set_resume()
+
+    report = {"mode": mode, "n_devices": jax.device_count(),
+              "worker_processes": cfg["workers"],
+              "base_seed": cfg["base_seed"]}
+    try:
+        opt.optimize()
+    except Preempted as e:
+        from analytics_zoo_tpu.parallel import checkpoint as ckpt_lib
+
+        snap_dir, man = ckpt_lib.newest_intact(ckpt)
+        report.update({
+            "preempted": True, "message": str(e)[:160],
+            "snapshot": os.path.basename(snap_dir),
+            "manifest_meta": {k: man["meta"][k] for k in
+                              ("epoch", "iteration", "iter_in_epoch")},
+        })
+        print("DRILL " + json.dumps(report))
+        return
+    state = opt._last_state
+    fp = float(sum(np.abs(np.asarray(l)).sum()
+                   for l in jax.tree_util.tree_leaves(state.params)))
+    report.update({"steps": int(np.asarray(state.step)),
+                   "fingerprint": repr(fp)})
+    if resume_meta is not None:
+        report["resumed_from"] = resume_meta
+        report["loader_start_epoch"] = start_epoch
+    print("DRILL " + json.dumps(report))
+
+
+def run_drill(args, env_for) -> dict:
+    """Three legs in fresh processes on the widest mesh: reference
+    (uninterrupted), preempt (SIGTERM mid-epoch 2 → forced checkpoint →
+    ``Preempted``), resume (same snapshot dir → finish).  Verdict:
+    resume fingerprint must equal the reference's — which requires the
+    loader's deterministic coordinates to survive the round trip."""
+    import tempfile
+
+    n = max(args.devices)
+    batches_per_epoch = _DRILL["n_records"] // _DRILL["batch"]
+    preempt_at = batches_per_epoch + 3          # 4 batches into epoch 2
+    legs = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        ckpt = os.path.join(tmp, "drill_ckpt")
+        for mode in ("reference", "preempt", "resume"):
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--_drill-child", mode, "--_drill-ckpt", ckpt,
+                   "--_drill-preempt-at", str(preempt_at),
+                   _CHILD_FLAG, str(n)]
+            out = subprocess.run(cmd, env=env_for(n), capture_output=True,
+                                 text=True, cwd=_REPO, timeout=600)
+            line = [ln for ln in out.stdout.splitlines()
+                    if ln.startswith("DRILL ")]
+            if out.returncode != 0 or not line:
+                return {"ok": False, "failed_leg": mode,
+                        "stderr": out.stderr[-800:]}
+            legs[mode] = json.loads(line[-1][len("DRILL "):])
+
+    ref, pre, res = legs["reference"], legs["preempt"], legs["resume"]
+    fp_ref = float(ref["fingerprint"])
+    fp_res = float(res["fingerprint"])
+    meta = pre.get("manifest_meta", {})
+    return {
+        "ok": (pre.get("preempted") is True
+               and res["steps"] == ref["steps"]
+               and fp_ref == fp_res),
+        "n_devices": n,
+        "preempt_at_global_batch": preempt_at,
+        "batches_per_epoch": batches_per_epoch,
+        "preempt": pre,
+        "resume": {**res, "fingerprint_delta": abs(fp_res - fp_ref)},
+        "reference": ref,
+        "fingerprint_match_bitexact": fp_ref == fp_res,
+        "loader_coordinates": {
+            "base_seed": _DRILL["base_seed"],
+            "checkpointed_epoch": meta.get("epoch"),
+            "checkpointed_iter_in_epoch": meta.get("iter_in_epoch"),
+            "mid_epoch": bool(meta.get("iter_in_epoch", 0)),
+        },
+        "policy": "resume == uninterrupted reference bit-exactly ⇔ the "
+                  "deterministic loader re-seeked to the exact "
+                  "(base_seed, epoch, batch index) coordinate the "
+                  "forced checkpoint recorded",
+    }
 
 
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--devices", type=int, nargs="+", default=[1, 2, 4, 8])
+    p.add_argument("--models", nargs="+", default=["ssd"],
+                   choices=["ssd", "ds2"])
     p.add_argument("--batch-per-chip", type=int, default=8)
+    p.add_argument("--ds2-batch-per-chip", type=int, default=None,
+                   help="per-chip batch for the ds2 sweep (default: "
+                        "--batch-per-chip); the SSD step is far heavier "
+                        "per record on a CPU host, so the two models "
+                        "usually want different sizes")
     p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--windows", type=int, default=3,
+                   help="timed windows per device count (per-window "
+                        "values kept; committed value = median)")
     p.add_argument("--res", type=int, default=300)
+    p.add_argument("--ds2-hidden", type=int, default=256)
+    p.add_argument("--ds2-layers", type=int, default=2)
+    p.add_argument("--ds2-seconds", type=int, default=2)
     p.add_argument("--virtual", action="store_true",
                    help="emulate each mesh size on CPU (mechanism check, "
                         "NOT a performance measurement)")
+    p.add_argument("--drill", action="store_true",
+                   help="preemption-resume chaos drill on the widest mesh")
+    p.add_argument("--emit", default=None,
+                   help="write the full artifact (sweeps + drill + "
+                        "run_metadata) to this path, e.g. "
+                        "MULTICHIP_r06.json")
+    p.add_argument("--sweep-log",
+                   default=os.path.join(_REPO, "bench_artifacts",
+                                        "BENCH_sweeps.jsonl"),
+                   help="append every sweep line here (like the bench.py "
+                        "phases); '' disables")
     p.add_argument(_CHILD_FLAG, type=int, default=None,
                    dest="child_n", help=argparse.SUPPRESS)
+    p.add_argument("--_child-model", default="ssd", dest="child_model",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--_drill-child", default=None, dest="drill_child",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--_drill-ckpt", default=None, dest="drill_ckpt",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--_drill-preempt-at", type=int, default=0,
+                   dest="drill_preempt_at", help=argparse.SUPPRESS)
     args = p.parse_args()
 
+    if args.child_n is not None and args.drill_child:
+        drill_child(args.drill_child, args.drill_ckpt,
+                    args.drill_preempt_at)
+        return 0
     if args.child_n is not None:
-        child(args.child_n, args.batch_per_chip, args.steps, args.res)
+        if args.child_model == "ds2":
+            child_ds2(args.child_n, args.batch_per_chip, args.steps,
+                      args.windows, args.ds2_hidden, args.ds2_layers,
+                      args.ds2_seconds)
+        else:
+            child_ssd(args.child_n, args.batch_per_chip, args.steps,
+                      args.res, args.windows)
         return 0
 
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    results = []
-    for n in args.devices:
+    def env_for(n: int) -> dict:
         env = dict(os.environ)
-        env["PYTHONPATH"] = (repo_root + os.pathsep + env["PYTHONPATH"]
-                             if env.get("PYTHONPATH") else repo_root)
+        env["PYTHONPATH"] = (_REPO + os.pathsep + env["PYTHONPATH"]
+                             if env.get("PYTHONPATH") else _REPO)
         if args.virtual:
             env["PALLAS_AXON_POOL_IPS"] = ""
             env["JAX_PLATFORMS"] = "cpu"
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                                 + f" --xla_force_host_platform_device_count={n}"
                                 ).strip()
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), _CHILD_FLAG, str(n),
-             "--batch-per-chip", str(args.batch_per_chip),
-             "--steps", str(args.steps), "--res", str(args.res)],
-            env=env, capture_output=True, text=True, cwd=repo_root)
-        line = [l for l in out.stdout.splitlines() if l.startswith("{")]
-        if not line:
-            print(json.dumps({"n": n, "error": out.stderr[-500:]}),
-                  file=sys.stderr)
-            continue
-        results.append(json.loads(line[-1]))
+        return env
 
-    if results:
-        base = results[0]["images_per_sec"] / results[0]["n"]
-        for r in results:
-            r["efficiency_vs_1chip"] = round(
-                r["images_per_sec"] / (r["n"] * base), 3)
-            r["virtual"] = bool(args.virtual)
-            print(json.dumps(r))
+    rate_key = {"ssd": "images_per_sec", "ds2": "records_per_sec"}
+    all_sweeps = {}
+    for model in args.models:
+        bpc = (args.ds2_batch_per_chip
+               if model == "ds2" and args.ds2_batch_per_chip is not None
+               else args.batch_per_chip)
+        results = []
+        for n in args.devices:
+            cmd = [sys.executable, os.path.abspath(__file__), _CHILD_FLAG,
+                   str(n), "--_child-model", model,
+                   "--batch-per-chip", str(bpc),
+                   "--steps", str(args.steps),
+                   "--windows", str(args.windows),
+                   "--res", str(args.res),
+                   "--ds2-hidden", str(args.ds2_hidden),
+                   "--ds2-layers", str(args.ds2_layers),
+                   "--ds2-seconds", str(args.ds2_seconds)]
+            out = subprocess.run(cmd, env=env_for(n), capture_output=True,
+                                 text=True, cwd=_REPO)
+            line = [ln for ln in out.stdout.splitlines()
+                    if ln.startswith("{")]
+            if not line:
+                print(json.dumps({"model": model, "n": n,
+                                  "error": out.stderr[-500:]}),
+                      file=sys.stderr)
+                continue
+            results.append(json.loads(line[-1]))
+
+        key = rate_key[model]
+        if results:
+            base = results[0][key] / results[0]["n"]
+            for r in results:
+                r["efficiency_vs_1chip"] = round(
+                    r[key] / (r["n"] * base), 3)
+                r["virtual"] = bool(args.virtual)
+                print(json.dumps(r))
+                _append_sweep_log(args.sweep_log,
+                                  {"metric": f"scaling_{model}_n{r['n']}",
+                                   **r})
+        all_sweeps[model] = results
+
+    drill = None
+    if args.drill:
+        drill = run_drill(args, env_for)
+        print(json.dumps({"drill": drill}))
+
+    if args.emit:
+        from analytics_zoo_tpu.obs import run_metadata
+
+        artifact = {
+            "round": 6,
+            "tool": "bench_scaling",
+            "virtual": bool(args.virtual),
+            "devices": args.devices,
+            "batch_per_chip": args.batch_per_chip,
+            "windows_per_point": args.windows,
+            "substrate": "parallel/specs.py declare-once SpecSet: "
+                         "pipeline_specs('ssd'/'ds2') -> annotated jit "
+                         "(in_shardings place host batches; state "
+                         "NamedShardings declared once) — the ISSUE 9 "
+                         "unified mesh substrate; children never call "
+                         "shard_batch/device_put",
+            "policy": "weak scaling at fixed per-chip batch, one fresh "
+                      "subprocess per device count (XLA pins the count "
+                      "at init), median of per-window rates with "
+                      "windows recorded; virtual=true ⇒ CPU host "
+                      "emulation validates MECHANISM not performance "
+                      "(cores shared, efficiency trends to 1/n by "
+                      "construction — the MULTICHIP_r0* convention)",
+            "sweeps": all_sweeps,
+            "drill": drill,
+            "run_metadata": run_metadata("bench_scaling", seed=0),
+        }
+        with open(args.emit, "w") as f:
+            json.dump(artifact, f, indent=1, sort_keys=False)
+            f.write("\n")
+        print(f"wrote {args.emit}")
     return 0
 
 
