@@ -1,0 +1,463 @@
+"""Source engine: AST rules encoding the repo's invariants.
+
+Each rule is a small object with a ``name``, a one-line ``doc``, and a
+``check(ctx)`` generator over :class:`~analytics_zoo_tpu.analysis.base.
+Violation`.  The engine parses every package module ONCE into a
+:class:`ModuleContext` (AST + import-alias table + raw lines — nothing
+is imported or executed, so a rule can never be dodged by import-time
+side effects) and runs every rule over it, then applies the in-source
+``az-allow`` waivers.
+
+Adding a rule (docs/ANALYSIS.md has the worked example):
+
+1. subclass/instantiate with a unique kebab-case ``name``;
+2. yield ``Violation``\\ s with the *package-relative* file path the
+   engine passed in ``ctx.display``;
+3. append the instance to :data:`SOURCE_RULES`;
+4. add the firing + clean fixture pair in ``tests/test_analyze.py``.
+
+The rules resolve import aliases (``import numpy as np``, ``import
+time as _time``, ``from jax.sharding import NamedSharding``) so renamed
+imports cannot slip past a textual match — the failure mode of the
+PR-8 grep gate this engine replaces.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence
+
+from analytics_zoo_tpu.analysis.base import (
+    Violation,
+    apply_waivers,
+    parse_waivers,
+)
+
+
+@dataclasses.dataclass
+class ModuleContext:
+    """One parsed module: package-relative path, AST, raw lines, and the
+    local-name → dotted-origin import table."""
+
+    rel: str              # posix path relative to the scan root
+    display: str          # path used in diagnostics (root name + rel)
+    tree: ast.Module
+    lines: List[str]
+    aliases: Dict[str, str]
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted origin of a Name/Attribute chain, through the alias
+        table: ``np.random.seed`` → ``numpy.random.seed``,
+        ``_time.monotonic`` → ``time.monotonic``, a bare
+        ``NamedSharding`` imported from ``jax.sharding`` →
+        ``jax.sharding.NamedSharding``."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        parts.reverse()
+        head = parts[0]
+        if head in self.aliases:
+            return ".".join([self.aliases[head]] + parts[1:])
+        return ".".join(parts)
+
+
+def _import_aliases(tree: ast.Module) -> Dict[str, str]:
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                local = a.asname or a.name.split(".")[0]
+                # `import numpy.random` binds the TOP package name
+                origin = a.name if a.asname else a.name.split(".")[0]
+                aliases[local] = origin
+        elif isinstance(node, ast.ImportFrom):
+            mod = ("." * node.level) + (node.module or "")
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{mod}.{a.name}" if mod \
+                    else a.name
+    return aliases
+
+
+def _calls(tree: ast.Module) -> Iterator[ast.Call]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def _last_component(ctx: ModuleContext, func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class OneClock:
+    """No ``time.time()``/``time.monotonic()`` outside the injected
+    clock module — every time-based decision (deadlines, shedding,
+    stall detection, span timestamps, epoch/eval throughput logs) must
+    read the ONE clock so drills replay deterministically under
+    ``VirtualClock`` (the RESILIENCE_r03/OBS_r01 contract)."""
+
+    name: str = "one-clock"
+    allowed: FrozenSet[str] = frozenset({"utils/clock.py"})
+    _BANNED = frozenset({"time.time", "time.monotonic"})
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        if ctx.rel in self.allowed:
+            return
+        for call in _calls(ctx.tree):
+            r = ctx.resolve(call.func)
+            if r in self._BANNED:
+                yield Violation(
+                    rule=self.name, file=ctx.display, line=call.lineno,
+                    message=f"{r}() read outside utils/clock.py — inject "
+                            f"a Clock/now-fn (utils.clock.as_now_fn) so "
+                            f"virtual-clock drills stay deterministic")
+
+
+@dataclasses.dataclass
+class OnePlacementSite:
+    """No ``jax.device_put`` / ``NamedSharding(`` construction outside
+    the declare-once substrate (``parallel/specs.py`` and the mesh/
+    tensor placement engines it delegates to) — the AST generalization
+    of the PR-8 grep gate, covering the WHOLE package instead of two
+    directories and ignoring docstrings/comments."""
+
+    name: str = "one-placement-site"
+    allowed: FrozenSet[str] = frozenset({
+        "parallel/specs.py",     # the declaration + its one payoff site
+        "parallel/mesh.py",      # place/replicate engine specs delegates to
+        "parallel/tensor.py",    # rule-resolved shard_tree engine
+    })
+    _BANNED = frozenset({"device_put", "NamedSharding"})
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        if ctx.rel in self.allowed:
+            return
+        for call in _calls(ctx.tree):
+            last = _last_component(ctx, call.func)
+            if last in self._BANNED:
+                yield Violation(
+                    rule=self.name, file=ctx.display, line=call.lineno,
+                    message=f"{last}( constructs device placement outside "
+                            f"the spec layer — declare a PartitionSpec in "
+                            f"parallel/specs.py and consume the SpecSet")
+
+
+#: numpy.random module-level draw/state functions (the GLOBAL RNG).
+_NP_MODULE_DRAWS = frozenset({
+    "rand", "randn", "randint", "random", "random_sample", "ranf",
+    "sample", "choice", "bytes", "shuffle", "permutation", "uniform",
+    "normal", "standard_normal", "beta", "binomial", "chisquare",
+    "dirichlet", "exponential", "f", "gamma", "geometric", "gumbel",
+    "hypergeometric", "laplace", "logistic", "lognormal", "logseries",
+    "multinomial", "multivariate_normal", "negative_binomial",
+    "noncentral_chisquare", "noncentral_f", "pareto", "poisson", "power",
+    "rayleigh", "standard_cauchy", "standard_exponential",
+    "standard_gamma", "standard_t", "triangular", "vonmises", "wald",
+    "weibull", "zipf", "get_state", "set_state",
+})
+
+
+@dataclasses.dataclass
+class SeededRngOnly:
+    """Determinism by construction: no global ``np.random.seed``, no
+    module-level ``np.random.<draw>`` (both mutate/read process-global
+    state any import can perturb — the exact hazard the loader's
+    byte-identical-for-any-worker-count contract forbids), and no
+    unseeded ``Generator``/``RandomState`` construction (randomness must
+    derive from the (base_seed, epoch, index) chain, never the OS)."""
+
+    name: str = "seeded-rng-only"
+    allowed: FrozenSet[str] = frozenset()
+    #: constructors that draw OS entropy when called without a seed —
+    #: the Generator front door, the legacy RandomState, every stock
+    #: BitGenerator, and SeedSequence itself
+    _SEEDABLE_CTORS = frozenset({
+        "default_rng", "RandomState", "PCG64", "PCG64DXSM", "MT19937",
+        "Philox", "SFC64", "SeedSequence",
+    })
+
+    @staticmethod
+    def _unseeded_call(call: ast.Call) -> bool:
+        """No arguments, or an explicit ``None``/``seed=None`` first
+        seed — both fall back to OS entropy."""
+        if not call.args and not call.keywords:
+            return True
+        if call.args:
+            first = call.args[0]
+        else:
+            seed_kw = [k for k in call.keywords
+                       if k.arg in ("seed", "entropy")]
+            if not seed_kw:
+                return False
+            first = seed_kw[0].value
+        return isinstance(first, ast.Constant) and first.value is None
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        if ctx.rel in self.allowed:
+            return
+        for call in _calls(ctx.tree):
+            r = ctx.resolve(call.func)
+            if r is None or not r.startswith("numpy.random."):
+                continue
+            tail = r.rsplit(".", 1)[1]
+            if r == "numpy.random.seed":
+                yield Violation(
+                    rule=self.name, file=ctx.display, line=call.lineno,
+                    message="np.random.seed mutates the process-global "
+                            "RNG — thread a seeded np.random.Generator "
+                            "instead (data.parallel seeding chain)")
+            elif tail in _NP_MODULE_DRAWS:
+                yield Violation(
+                    rule=self.name, file=ctx.display, line=call.lineno,
+                    message=f"np.random.{tail} draws from the process-"
+                            f"global RNG — use a Generator seeded from "
+                            f"the stream position")
+            elif tail in self._SEEDABLE_CTORS and self._unseeded_call(call):
+                yield Violation(
+                    rule=self.name, file=ctx.display, line=call.lineno,
+                    message=f"{tail}() without a seed draws OS entropy — "
+                            f"derive the seed from the (base_seed, epoch, "
+                            f"index) chain")
+
+
+#: Modules on the step/dispatch hot path: the train step factories +
+#: host loop, the serving dispatch chain, and the two pipeline modules
+#: whose serving programs feed the runtime.
+_HOT_MODULES = frozenset({
+    "parallel/train.py",
+    "parallel/optim.py",
+    "serving/replica.py",
+    "serving/runtime.py",
+    "serving/batcher.py",
+    "serving/request.py",
+    "pipelines/ssd.py",
+    "pipelines/deepspeech2.py",
+})
+
+
+@dataclasses.dataclass
+class NoHostSyncInHotPath:
+    """No host synchronization inside step/dispatch modules: every
+    ``block_until_ready``/``.item()`` is a full device round-trip that
+    serializes the async dispatch pipeline (the overlap PR 2/PR 5 built),
+    and ``np.asarray``/``np.array`` inside a jit-bound function either
+    fails on tracers or silently constant-folds a batch.  The ONE
+    sanctioned sync point is ``obs/probe.py`` — syncing is its
+    measurement, by design."""
+
+    name: str = "no-host-sync-in-hot-path"
+    hot_modules: FrozenSet[str] = _HOT_MODULES
+    allowed: FrozenSet[str] = frozenset({"obs/probe.py"})
+    _HOST_MATERIALIZE = frozenset({"numpy.asarray", "numpy.array",
+                                   "jax.device_get"})
+
+    @staticmethod
+    def _is_jit_name(last: Optional[str]) -> bool:
+        """``jax.jit`` / ``pjit`` / repo jit-wrapper convention
+        (``_serving_jit``) — deliberately NOT a bare substring match, so
+        a helper that merely mentions 'jit' mid-name is not a jit
+        site."""
+        return last is not None and (last in ("jit", "pjit")
+                                     or last.endswith("_jit"))
+
+    def _jit_bound_spans(self, ctx: ModuleContext):
+        """Line spans of functions whose body runs under trace — the
+        static approximation covers both idioms: a function NAME passed
+        as the first positional argument of a jit call
+        (``jax.jit(step_fn, ...)``, ``self._serving_jit(detect, ...)``)
+        and decorator form (``@jax.jit`` / ``@partial(jax.jit, ...)``)."""
+        defs: Dict[str, List] = {}
+        spans: List = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            span = (node.lineno, node.end_lineno or node.lineno)
+            defs.setdefault(node.name, []).append(span)
+            for deco in node.decorator_list:
+                target = deco
+                if isinstance(deco, ast.Call):
+                    # @partial(jax.jit, ...) / @jax.jit(...)
+                    if deco.args and _last_component(
+                            ctx, deco.func) == "partial":
+                        target = deco.args[0]
+                    else:
+                        target = deco.func
+                if self._is_jit_name(_last_component(ctx, target)):
+                    spans.append(span)
+        for call in _calls(ctx.tree):
+            if self._is_jit_name(_last_component(ctx, call.func)) \
+                    and call.args and isinstance(call.args[0], ast.Name):
+                spans.extend(defs.get(call.args[0].id, ()))
+        return spans
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        if ctx.rel in self.allowed or ctx.rel not in self.hot_modules:
+            return
+        spans = self._jit_bound_spans(ctx)
+        for call in _calls(ctx.tree):
+            last = _last_component(ctx, call.func)
+            if last == "block_until_ready":
+                yield Violation(
+                    rule=self.name, file=ctx.display, line=call.lineno,
+                    message="block_until_ready in a hot-path module — "
+                            "syncing belongs to obs/probe.py (or waive "
+                            "with the reason the sync is load-bearing)")
+                continue
+            if last == "item" and not call.args and not call.keywords:
+                yield Violation(
+                    rule=self.name, file=ctx.display, line=call.lineno,
+                    message=".item() forces a device round-trip per "
+                            "scalar in a hot-path module")
+                continue
+            r = ctx.resolve(call.func)
+            if r in self._HOST_MATERIALIZE and any(
+                    s <= call.lineno <= e for s, e in spans):
+                yield Violation(
+                    rule=self.name, file=ctx.display, line=call.lineno,
+                    message=f"{last}( inside a jit-bound function — host "
+                            f"materialization on a tracer (move it out of "
+                            f"the traced body or keep it jnp)")
+
+
+@dataclasses.dataclass
+class TaxonomyComplete:
+    """Every exception class in ``resilience/errors.py`` must appear in
+    exactly one of ``_RETRYABLE_CLASSES``/``FATAL_ERRORS`` — an error
+    class outside both falls through ``run_resilient``'s retry filter
+    with unconsidered semantics (the PR-3 contract, now static: the
+    check runs without importing the module)."""
+
+    name: str = "taxonomy-complete"
+    target: str = "resilience/errors.py"
+    registries: Sequence[str] = ("_RETRYABLE_CLASSES", "FATAL_ERRORS")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        if ctx.rel != self.target:
+            return
+        classes: Dict[str, int] = {}
+        for node in ctx.tree.body:
+            if isinstance(node, ast.ClassDef) and node.bases:
+                classes[node.name] = node.lineno
+        registered: Dict[str, int] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                target = node.targets[0].id
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name):
+                target = node.target.id
+            else:
+                continue
+            if target not in self.registries:
+                continue
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Name):
+                        registered[elt.id] = node.lineno
+        for name, lineno in sorted(classes.items()):
+            if name not in registered:
+                yield Violation(
+                    rule=self.name, file=ctx.display, line=lineno,
+                    message=f"error class {name} is in neither "
+                            f"_RETRYABLE_CLASSES nor FATAL_ERRORS — "
+                            f"classify it so run_resilient's retry filter "
+                            f"has considered semantics")
+        for name, lineno in sorted(registered.items()):
+            if name not in classes:
+                yield Violation(
+                    rule=self.name, file=ctx.display, line=lineno,
+                    message=f"registry names {name}, which is not an "
+                            f"exception class defined in this module")
+
+
+def default_rules() -> List:
+    return [OneClock(), OnePlacementSite(), SeededRngOnly(),
+            NoHostSyncInHotPath(), TaxonomyComplete()]
+
+
+#: name → rule instance (the default catalog the CLI runs).
+SOURCE_RULES: Dict[str, object] = {r.name: r for r in default_rules()}
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+
+def package_root() -> str:
+    """The ``analytics_zoo_tpu`` package directory (the default scan
+    root)."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _iter_py_files(root: str) -> Iterator[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                yield os.path.join(dirpath, fname)
+
+
+def run_source_engine(root: Optional[str] = None,
+                      rules: Optional[Sequence] = None) -> List[Violation]:
+    """Parse every ``.py`` under ``root`` (default: the installed
+    package), run every rule, apply waivers.  Returns ALL violations —
+    waived ones carry ``waived=True``; callers gate on the un-waived
+    subset.
+
+    Rule path scopes (``allowed`` / ``hot_modules`` / ``target``) are
+    PACKAGE-root-relative (``utils/clock.py``), so a ``root`` that
+    merely *contains* the package (e.g. the repo checkout, ``--root .``)
+    is normalized down to its ``analytics_zoo_tpu/`` directory — scanning
+    from the wrong altitude would silently void every exemption and
+    flag the sanctioned modules themselves."""
+    root = os.path.abspath(root or package_root())
+    nested = os.path.join(root, "analytics_zoo_tpu")
+    if os.path.basename(root) != "analytics_zoo_tpu" \
+            and os.path.isdir(nested):
+        root = nested
+    rules = list(rules) if rules is not None else default_rules()
+    rootname = os.path.basename(root)
+    out: List[Violation] = []
+    for path in _iter_py_files(root):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        display = f"{rootname}/{rel}"
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            out.append(Violation(rule="parse-error", file=display,
+                                 line=e.lineno or 0,
+                                 message=f"syntax error: {e.msg}"))
+            continue
+        lines = source.splitlines()
+        ctx = ModuleContext(rel=rel, display=display, tree=tree,
+                            lines=lines, aliases=_import_aliases(tree))
+        found: List[Violation] = []
+        for rule in rules:
+            found.extend(rule.check(ctx))
+        waivers, malformed = parse_waivers(lines, display)
+        out.extend(apply_waivers(found, waivers,
+                                 active_rules=[r.name for r in rules]))
+        out.extend(malformed)
+    out.sort(key=lambda v: (v.file, v.line, v.rule))
+    return out

@@ -1,0 +1,381 @@
+"""The repo's program-audit suite: what ``az_analyze --program`` traces.
+
+Coverage contract (the ISSUE-10 acceptance line): all four registered
+pipelines' train + eval programs, plus every SSD and DS2 serving tier
+the degradation-ladder factories hand the runtime.
+
+Construction is ABSTRACT wherever values don't matter: parameters come
+from ``jax.eval_shape`` over ``module.init`` (a shape/dtype tree, no
+weight init compile, no FLOPs), batches are ``ShapeDtypeStruct`` s, and
+only the SSD serving tiers get cheap filled arrays because
+``quantize_params`` must read real values to compute int8 scales.  The
+whole suite traces in a few seconds on the 2-core CPU host — which is
+what lets the audit run inside tier-1 on every suite pass.
+
+The serving-tier programs are NOT reconstructed here: the tier
+factories (``pipelines.ssd.ssd_serving_tiers`` / ``pipelines.
+deepspeech2.ds2_serving_tiers``) attach a ``device_program`` thunk to
+each :class:`~analytics_zoo_tpu.serving.ladder.ServingTier`, and this
+module audits exactly those — the audit covers the programs the
+runtime will actually dispatch, not a parallel copy that could drift.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from analytics_zoo_tpu.analysis.program import AuditProgram, BuiltProgram
+
+
+def _S(shape, dtype) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def abstract_variables(module, *example_inputs, **init_kwargs):
+    """``module.init``'s variable tree as shapes/dtypes only — traced
+    under ``eval_shape``, so no RNG work and no init compile."""
+    return jax.eval_shape(
+        lambda rng, *args: module.init(rng, *args, **init_kwargs),
+        jax.random.PRNGKey(0), *example_inputs)
+
+
+def abstract_train_state(module, optim, *example_inputs, **init_kwargs
+                         ) -> Tuple[Any, Any]:
+    """(variables, TrainState) as abstract trees — structure-true to
+    ``create_train_state`` (same leaves, same optimizer slots), value-
+    free."""
+    from analytics_zoo_tpu.parallel.train import TrainState
+
+    variables = abstract_variables(module, *example_inputs, **init_kwargs)
+    params = variables["params"]
+    model_state = {k: v for k, v in variables.items() if k != "params"}
+    state = TrainState(
+        step=_S((), np.int32),
+        params=params,
+        model_state=model_state,
+        opt_state=jax.eval_shape(optim.tx.init, params),
+        rng=jax.eval_shape(jax.random.PRNGKey, 0),
+    )
+    return variables, state
+
+
+def filled(tree) -> Any:
+    """Abstract tree → cheap concrete arrays (0.5 for floats, zeros for
+    ints) — for the paths that must read values (int8 quantization
+    scales)."""
+    return jax.tree_util.tree_map(
+        lambda s: np.full(s.shape, 0.5, s.dtype)
+        if np.issubdtype(s.dtype, np.floating)
+        else np.zeros(s.shape, s.dtype), tree)
+
+
+# ---------------------------------------------------------------------------
+# Per-pipeline target builders (lazy — nothing imports models until the
+# program engine actually runs)
+# ---------------------------------------------------------------------------
+
+
+def _fraud(mesh) -> List[AuditProgram]:
+    def build_train() -> BuiltProgram:
+        from analytics_zoo_tpu.core.criterion import ClassNLLCriterion
+        from analytics_zoo_tpu.models import FraudMLP
+        from analytics_zoo_tpu.parallel import (Adam, make_train_step,
+                                                pipeline_specs)
+
+        module = FraudMLP(in_features=29, hidden=10, n_classes=2)
+        specs = pipeline_specs("fraud", mesh=mesh)
+        optim = Adam(1e-3)
+        _, state = abstract_train_state(module, optim,
+                                        _S((1, 29), np.float32))
+        step = make_train_step(module, ClassNLLCriterion(), optim,
+                               specs=specs, state=state)
+        B = specs.data_axis_size
+        batch = {"input": _S((B, 29), np.float32),
+                 "target": _S((B,), np.int32)}
+        return BuiltProgram(fn=step, args=(state, batch, 1.0),
+                            specs=specs, donate_state=state)
+
+    def build_eval() -> BuiltProgram:
+        from analytics_zoo_tpu.models import FraudMLP
+        from analytics_zoo_tpu.parallel import (Adam, make_eval_step,
+                                                pipeline_specs)
+
+        module = FraudMLP(in_features=29, hidden=10, n_classes=2)
+        specs = pipeline_specs("fraud", mesh=mesh)
+        variables = abstract_variables(module, _S((1, 29), np.float32))
+        ev = make_eval_step(module, specs=specs)
+        B = specs.data_axis_size
+        return BuiltProgram(fn=ev, args=(variables, _S((B, 29),
+                                                       np.float32)),
+                            specs=specs)
+
+    return [AuditProgram("fraud/train", build_train),
+            AuditProgram("fraud/eval", build_eval)]
+
+
+def _ds2(mesh) -> List[AuditProgram]:
+    T, MELS, LAB = 32, 13, 4
+
+    def _module():
+        from analytics_zoo_tpu.models import DeepSpeech2
+
+        return DeepSpeech2(hidden=16, n_rnn_layers=1, n_mels=MELS)
+
+    def build_train() -> BuiltProgram:
+        from analytics_zoo_tpu.parallel import (Adam, make_train_step,
+                                                pipeline_specs)
+        from analytics_zoo_tpu.pipelines.deepspeech2 import (
+            ds2_ctc_criterion, ds2_padding_metric)
+
+        module = _module()
+        specs = pipeline_specs("ds2", mesh=mesh)
+        optim = Adam(1e-3)
+        _, state = abstract_train_state(
+            module, optim, _S((1, T, MELS), np.float32))
+        step = make_train_step(module, ds2_ctc_criterion(), optim,
+                               specs=specs, state=state,
+                               metric_fn=ds2_padding_metric)
+        B = specs.data_axis_size
+        # the production bucketed-batch contract: input=(features,
+        # n_frames), n_frames top-level for the CTC logit mask + metric
+        batch = {"input": (_S((B, T, MELS), np.float32),
+                           _S((B,), np.int32)),
+                 "n_frames": _S((B,), np.int32),
+                 "labels": _S((B, LAB), np.int32),
+                 "label_mask": _S((B, LAB), np.float32)}
+        return BuiltProgram(fn=step, args=(state, batch, 1.0),
+                            specs=specs, donate_state=state)
+
+    def build_eval() -> BuiltProgram:
+        from analytics_zoo_tpu.parallel import (make_eval_step,
+                                                pipeline_specs)
+
+        module = _module()
+        specs = pipeline_specs("ds2", mesh=mesh)
+        variables = abstract_variables(module, _S((1, T, MELS),
+                                                  np.float32))
+        ev = make_eval_step(module, specs=specs)
+        B = specs.data_axis_size
+        return BuiltProgram(fn=ev,
+                            args=(variables, _S((B, T, MELS), np.float32)),
+                            specs=specs)
+
+    return [AuditProgram("ds2/train", build_train),
+            AuditProgram("ds2/eval", build_eval)]
+
+
+def _ssd(mesh) -> List[AuditProgram]:
+    RES, NCLS, G = 300, 4, 8
+
+    def build_train() -> BuiltProgram:
+        from analytics_zoo_tpu.models import (SSDVgg, build_priors,
+                                              ssd300_config)
+        from analytics_zoo_tpu.ops.multibox_loss import (MultiBoxLoss,
+                                                         MultiBoxLossParam)
+        from analytics_zoo_tpu.parallel import (SGD, make_train_step,
+                                                pipeline_specs)
+
+        module = SSDVgg(num_classes=NCLS, resolution=RES)
+        specs = pipeline_specs("ssd", mesh=mesh)
+        optim = SGD(1e-3, momentum=0.9)
+        _, state = abstract_train_state(
+            module, optim, _S((1, RES, RES, 3), np.float32))
+        priors, variances = build_priors(ssd300_config())
+        crit = MultiBoxLoss(priors, variances,
+                            MultiBoxLossParam(n_classes=NCLS))
+        step = make_train_step(module, crit, optim, specs=specs,
+                               state=state, skip_loss_above=50.0)
+        B = specs.data_axis_size
+        batch = {"input": _S((B, RES, RES, 3), np.float32),
+                 "target": {"bboxes": _S((B, G, 4), np.float32),
+                            "labels": _S((B, G), np.float32),
+                            "mask": _S((B, G), np.float32)}}
+        return BuiltProgram(fn=step, args=(state, batch, 1.0),
+                            specs=specs, donate_state=state)
+
+    def build_eval() -> BuiltProgram:
+        from analytics_zoo_tpu.models import SSDVgg
+        from analytics_zoo_tpu.parallel import (make_eval_step,
+                                                pipeline_specs)
+
+        module = SSDVgg(num_classes=NCLS, resolution=RES)
+        specs = pipeline_specs("ssd", mesh=mesh)
+        variables = abstract_variables(module,
+                                       _S((1, RES, RES, 3), np.float32))
+        ev = make_eval_step(module, specs=specs)
+        B = specs.data_axis_size
+        return BuiltProgram(fn=ev,
+                            args=(variables,
+                                  _S((B, RES, RES, 3), np.float32)),
+                            specs=specs)
+
+    return [AuditProgram("ssd/train", build_train),
+            AuditProgram("ssd/eval", build_eval)]
+
+
+def _frcnn(mesh) -> List[AuditProgram]:
+    RES, NCLS, G = 128, 4, 8
+
+    def _module():
+        from analytics_zoo_tpu.models import FasterRcnnVgg, FrcnnParam
+        from analytics_zoo_tpu.ops.proposal import ProposalParam
+
+        return FasterRcnnVgg(param=FrcnnParam(
+            num_classes=NCLS,
+            proposal=ProposalParam(pre_nms_topn=64, post_nms_topn=16)))
+
+    def build_train() -> BuiltProgram:
+        from analytics_zoo_tpu.ops.frcnn_train import (
+            FrcnnLossParam, frcnn_training_loss)
+        from analytics_zoo_tpu.parallel import (SGD, make_train_step,
+                                                pipeline_specs)
+
+        module = _module()
+        specs = pipeline_specs("frcnn", mesh=mesh)
+        optim = SGD(1e-3, momentum=0.9)
+        _, state = abstract_train_state(
+            module, optim, _S((1, RES, RES, 3), np.float32),
+            _S((1, 3), np.float32))
+
+        def forward_fn(variables, inputs, train=False, rngs=None):
+            x, im_info, gt_px, gt_mask = inputs
+            out = module.apply(variables, x, im_info, train=train,
+                               extra_rois=gt_px, extra_rois_mask=gt_mask,
+                               train_outputs=True, rngs=rngs)
+            return out, None
+
+        loss_param = FrcnnLossParam()
+        step = make_train_step(
+            module, lambda out, b: frcnn_training_loss(out, b, loss_param),
+            optim, specs=specs, state=state, forward_fn=forward_fn,
+            grad_clip_norm=10.0)
+        B = specs.data_axis_size
+        batch = {"input": (_S((B, RES, RES, 3), np.float32),
+                           _S((B, 3), np.float32),
+                           _S((B, G, 4), np.float32),
+                           _S((B, G), np.float32)),
+                 "im_info": _S((B, 3), np.float32),
+                 "target": {"bboxes": _S((B, G, 4), np.float32),
+                            "labels": _S((B, G), np.int32),
+                            "mask": _S((B, G), np.float32)}}
+        return BuiltProgram(fn=step, args=(state, batch, 1.0),
+                            specs=specs, donate_state=state)
+
+    def build_eval() -> BuiltProgram:
+        from analytics_zoo_tpu.parallel import (make_eval_step,
+                                                pipeline_specs)
+
+        module = _module()
+        specs = pipeline_specs("frcnn", mesh=mesh)
+        variables = abstract_variables(module,
+                                       _S((1, RES, RES, 3), np.float32),
+                                       _S((1, 3), np.float32))
+        ev = make_eval_step(module, specs=specs)
+        B = specs.data_axis_size
+        return BuiltProgram(fn=ev,
+                            args=(variables,
+                                  (_S((B, RES, RES, 3), np.float32),
+                                   _S((B, 3), np.float32))),
+                            specs=specs)
+
+    return [AuditProgram("frcnn/train", build_train),
+            AuditProgram("frcnn/eval", build_eval)]
+
+
+def _tier_targets(kind: str, tiers, specs) -> List[AuditProgram]:
+    """Wrap each ServingTier's attached ``device_program`` thunk as an
+    audit target (a tier without one is itself a finding — the factory
+    stopped exposing its program to the audit)."""
+    out: List[AuditProgram] = []
+    for tier in tiers:
+        name = f"{kind}/serve:{tier.name}"
+        if tier.device_program is None:
+            def build_missing(tier_name=tier.name) -> BuiltProgram:
+                raise RuntimeError(
+                    f"serving tier {tier_name!r} carries no "
+                    f"device_program thunk — the tier factory must "
+                    f"expose its jitted program for the audit")
+            out.append(AuditProgram(name, build_missing))
+            continue
+
+        def build(thunk=tier.device_program, specs=specs) -> BuiltProgram:
+            fn, args, static = thunk()
+            return BuiltProgram(fn=fn, args=args, static_argnums=static,
+                                specs=specs)
+        out.append(AuditProgram(name, build))
+    return out
+
+
+def _ssd_serving(mesh) -> List[AuditProgram]:
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.models import SSDVgg
+    from analytics_zoo_tpu.parallel import pipeline_specs
+    from analytics_zoo_tpu.pipelines.ssd import (PreProcessParam,
+                                                 ssd_serving_tiers)
+
+    RES, NCLS = 300, 4
+    module = SSDVgg(num_classes=NCLS, resolution=RES)
+    # int8 quantization reads weight values for its scales → filled
+    # arrays (cheap constants), not eval_shape structs
+    model = Model(module)
+    model.variables = filled(abstract_variables(
+        module, _S((1, RES, RES, 3), np.float32)))
+    specs = pipeline_specs("ssd", mesh=mesh)
+    tiers = ssd_serving_tiers(
+        model, PreProcessParam(batch_size=specs.data_axis_size,
+                               resolution=RES),
+        n_classes=NCLS, specs=specs)
+    return _tier_targets("ssd", tiers, specs)
+
+
+def _ds2_serving(mesh) -> List[AuditProgram]:
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.models import DeepSpeech2
+    from analytics_zoo_tpu.parallel import pipeline_specs
+    from analytics_zoo_tpu.pipelines.deepspeech2 import (DS2Param,
+                                                         ds2_serving_tiers)
+
+    module = DeepSpeech2(hidden=16, n_rnn_layers=1, n_mels=13)
+    model = Model(module)
+    model.variables = abstract_variables(module,
+                                         _S((1, 64, 13), np.float32))
+    specs = pipeline_specs("ds2", mesh=mesh)
+    tiers = ds2_serving_tiers(model, DS2Param(decoder="beam"), specs=specs)
+    return _tier_targets("ds2", tiers, specs)
+
+
+def _guarded_tiers(kind: str, builder, mesh) -> List[AuditProgram]:
+    """The serving-tier targets need the tier FACTORIES to run before
+    the target names are even known (names come from the rungs).  A
+    factory that explodes must surface as a finding on that family —
+    not crash suite construction and take the healthy train/eval
+    targets down with it (audit_program's per-target contract)."""
+    try:
+        return builder(mesh)
+    except Exception as e:
+        msg = f"{type(e).__name__}: {e}"
+
+        def build_fail() -> BuiltProgram:
+            raise RuntimeError(
+                f"serving-tier factory failed before any program could "
+                f"be traced: {msg}")
+        return [AuditProgram(f"{kind}/serve:<factory-failed>", build_fail)]
+
+
+def repo_audit_suite(mesh=None) -> List[AuditProgram]:
+    """Every program the ISSUE-10 audit must cover, lazily built on
+    ``mesh`` (default: 1-D data mesh over all local devices)."""
+    from analytics_zoo_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh or mesh_lib.create_mesh()
+    targets: List[AuditProgram] = []
+    targets += _ssd(mesh)
+    targets += _frcnn(mesh)
+    targets += _ds2(mesh)
+    targets += _fraud(mesh)
+    targets += _guarded_tiers("ssd", _ssd_serving, mesh)
+    targets += _guarded_tiers("ds2", _ds2_serving, mesh)
+    return targets

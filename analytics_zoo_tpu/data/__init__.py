@@ -37,6 +37,7 @@ from analytics_zoo_tpu.data.prefetch import (PrefetchDataSet,
                                              overlap_window)
 from analytics_zoo_tpu.data.parallel import (ParallelLoader,
                                              make_input_pipeline,
+                                             sample_rng,
                                              seed_rngs,
                                              stable_seed)
 from analytics_zoo_tpu.data.synthetic import (

@@ -156,19 +156,40 @@ def stream_stage_keys(leading: Sequence[Transformer]) -> List[str]:
     return keys
 
 
+#: Process-local numpy Generator for per-sample transform randomness —
+#: the sanctioned replacement for drawing from numpy's process-GLOBAL
+#: RNG (which ``seed_sample`` historically ``np.random.seed``-ed per
+#: sample; az-analyze's seeded-rng-only rule now bans both the global
+#: seed and global draws: global state any import can perturb is
+#: exactly what the byte-identical-for-any-worker-count contract cannot
+#: be built on).  ``seed_sample`` rewinds THIS Generator from
+#: ``(base_seed, epoch, sample_index)`` in whichever process runs the
+#: chain, so a transform drawing from ``sample_rng()`` sees the same
+#: stream in a forked worker, a respawned worker, and the serial
+#: reference.
+_SAMPLE_RNG = np.random.Generator(np.random.PCG64(0))
+
+
+def sample_rng() -> np.random.Generator:
+    """The per-sample-seeded local Generator for transform chains."""
+    return _SAMPLE_RNG
+
+
 def seed_sample(chain: Optional[Sequence[Transformer]], base_seed: int,
                 epoch: int, index: int) -> None:
     """Pin ALL randomness for one sample's trip through the chain.
 
     The vision transforms draw from the module-level ``random`` (and the
-    samplers derive their numpy Generator from it), so seeding the
-    global module + any chain-held RNG instances from ``(base_seed,
-    epoch, sample_index)`` makes the augmentation decisions a pure
-    function of the sample's stream position — independent of which
-    worker (or thread, or respawn attempt) runs it."""
+    samplers derive their numpy Generators from it), numpy consumers
+    draw from the loader's local :func:`sample_rng`, and chain-held RNG
+    instances are reseeded by ``seed_rngs`` — all from ``(base_seed,
+    epoch, sample_index)``, so the augmentation decisions are a pure
+    function of the sample's stream position, independent of which
+    worker (or thread, or respawn attempt) runs it.  The numpy GLOBAL
+    RNG is deliberately left alone."""
     s = stable_seed("sample", base_seed, epoch, index)
     random.seed(s)
-    np.random.seed(s & 0xFFFFFFFF)
+    _SAMPLE_RNG.bit_generator.state = np.random.PCG64(s).state
     if chain:
         seed_rngs(chain, stable_seed("chain", base_seed, epoch, index))
 
@@ -514,15 +535,17 @@ class ParallelLoader:
     epoch (advancing the shuffle state exactly like serial epochs do)
     and owns the worker pool until exhausted or ``.close()``d.
 
-    Note on global RNGs: the vision/augment transforms draw from the
-    process-global ``random`` / ``np.random`` (pre-existing design), so
-    pinning them means ``seed_sample`` reseeds those globals per sample
-    in whichever process runs the chain.  With ``num_workers>0`` that
-    is a forked worker; with ``num_workers=0`` it is THIS process (the
-    prefetch thread, when composed with ``device_prefetch``) — code
-    that draws from the global RNGs concurrently with a serial-mode
-    epoch will see sample-pinned values, exactly as it already would
-    next to a ``ParallelTransformer`` thread pool.
+    Note on shared RNGs: the vision/augment transforms draw from the
+    process-global ``random`` (pre-existing design) and numpy consumers
+    from the loader-local :func:`sample_rng` Generator, so pinning them
+    means ``seed_sample`` reseeds both per sample in whichever process
+    runs the chain (numpy's process-GLOBAL RNG is never touched —
+    seeded-rng-only rule).  With ``num_workers>0`` that is a forked
+    worker; with ``num_workers=0`` it is THIS process (the prefetch
+    thread, when composed with ``device_prefetch``) — code that draws
+    from those RNGs concurrently with a serial-mode epoch will see
+    sample-pinned values, exactly as it already would next to a
+    ``ParallelTransformer`` thread pool.
     """
 
     def __init__(self, dataset, num_workers: int = 0, *,

@@ -307,10 +307,12 @@ def sequence_parallel_forward(variables, x, mesh,
     else:
         fn = _shard_map(local, mesh, in_specs=(p_specs, s_specs, spec),
                         out_specs=spec)
+    # az-allow: one-placement-site — the time-sharded forward places T over 'sequence' itself; SpecSet expresses batch/state placement only (ROADMAP: fold in)
     sharding = NamedSharding(mesh, spec)
     if isinstance(x, jax.core.Tracer):   # under jit: constrain, don't put
         x = jax.lax.with_sharding_constraint(x, sharding)
     else:
+        # az-allow: one-placement-site — eager leg of the same time-sharded staging (see above)
         x = jax.device_put(x, sharding)
     return fn(params, stats, x)
 

@@ -249,15 +249,18 @@ class Trigger:
         return Trigger(lambda s: True, "always")
 
     @staticmethod
-    def max_wall_time(seconds: float) -> "Trigger":
+    def max_wall_time(seconds: float, clock=None) -> "Trigger":
         """Fires once ``seconds`` of wall time elapsed since the trigger
         was CREATED (host-side clock).  The bounded-run guard for drills
         and preemptible jobs: compose as ``Trigger.or_(max_epoch(n),
-        max_wall_time(t))`` so a restart-looping run still terminates."""
-        import time as _time
+        max_wall_time(t))`` so a restart-looping run still terminates.
+        ``clock``: injected time source (utils.clock convention) — a
+        VirtualClock makes the trigger deterministic in drills."""
+        from analytics_zoo_tpu.utils.clock import as_now_fn
 
-        start = _time.monotonic()
-        return Trigger(lambda s: _time.monotonic() - start >= seconds,
+        now = as_now_fn(clock)
+        start = now()
+        return Trigger(lambda s: now() - start >= seconds,
                        f"maxWallTime({seconds}s)")
 
     @staticmethod

@@ -46,6 +46,7 @@ NEG_INF = -1e30
 def shard_sequence(x, mesh: Mesh, axis_name: str = SEQUENCE_AXIS):
     """Place (B, T, …) on the mesh with T sharded over ``axis_name``."""
     spec = P(None, axis_name, *([None] * (np.ndim(x) - 2)))
+    # az-allow: one-placement-site — T-axis staging predates the SpecSet substrate; folding sequence parallelism into specs is ROADMAP work
     return jax.device_put(x, NamedSharding(mesh, spec))
 
 

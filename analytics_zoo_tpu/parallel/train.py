@@ -495,8 +495,15 @@ class Optimizer:
                  compute_dtype=None, device_transform=None,
                  param_rules=None, prefetch: int = 0,
                  grad_accum: int = 1, forward_fn=None,
-                 batch_overrides=None, metric_fn=None, specs=None):
+                 batch_overrides=None, metric_fn=None, specs=None,
+                 clock=None):
         from analytics_zoo_tpu.parallel.specs import SpecSet
+        from analytics_zoo_tpu.utils.clock import as_now_fn
+
+        # epoch/throughput timing reads the ONE injected clock (utils.
+        # clock, az-analyze one-clock rule) — a VirtualClock makes the
+        # records/s epoch log deterministic in drills
+        self._now = as_now_fn(clock)
 
         self.model = model
         self.dataset = dataset
@@ -791,7 +798,7 @@ class Optimizer:
             ph.install()
         if wd is not None:
             wd.start()
-        t_epoch = time.time()
+        t_epoch = self._now()
         records = 0
         stop = False
         sentinel = object()
@@ -942,10 +949,10 @@ class Optimizer:
                 loop.epoch_finished = True
                 self._iter_in_epoch = 0
                 loop.loss = float(loop.loss)
-                dt = time.time() - t_epoch
+                dt = self._now() - t_epoch
                 logger.info("Epoch %d done: %d records in %.1fs (%.1f records/s), loss %.4f",
                             loop.epoch, records, dt, records / max(dt, 1e-9), loop.loss)
-                t_epoch, records = time.time(), 0
+                t_epoch, records = self._now(), 0
                 self._boundary_checks(loop, state, eval_step, wd, ph)
                 if self.epoch_hook is not None:
                     self.epoch_hook(loop, state)

@@ -15,10 +15,10 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import time
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from analytics_zoo_tpu.core.module import Model
@@ -71,9 +71,15 @@ class DeepSpeech2Pipeline:
     """
 
     def __init__(self, model: Model, param: DS2Param = DS2Param(),
-                 sequence_mesh=None):
+                 sequence_mesh=None, clock=None):
+        from analytics_zoo_tpu.utils.clock import as_now_fn
+
         self.model = model
         self.param = param
+        # eval/throughput timing reads the ONE injected clock (utils.
+        # clock) — az-analyze's one-clock rule pins it; tests may pass a
+        # VirtualClock for deterministic throughput logs
+        self._now = as_now_fn(clock)
         self.segmenter = TimeSegmenter(
             segment_size=SAMPLE_RATE * param.segment_seconds)
         self.utt_length = param.utt_length
@@ -264,13 +270,13 @@ class DeepSpeech2Pipeline:
                  transcripts: Dict[str, str]) -> ASREvaluator:
         """WER/CER over labeled utterances (reference InferenceEvaluate
         per-utterance WER/CER print + total time log)."""
-        t0 = time.time()
+        t0 = self._now()
         hyps = self.transcribe_samples(utterances)
         ev = ASREvaluator()
         for audio_id, ref in transcripts.items():
             hyp = hyps.get(audio_id, "")
             ev.add(ref.upper(), hyp)
-        dt = time.time() - t0
+        dt = self._now() - t0
         logger.info("DS2 eval: %d utterances in %.2fs (%.2f utt/sec), "
                     "WER=%.4f CER=%.4f", len(transcripts), dt,
                     len(transcripts) / max(dt, 1e-9), ev.wer, ev.cer)
@@ -734,6 +740,17 @@ def ds2_serving_tiers(model: Model, param: Optional[DS2Param] = None,
     param = param or DS2Param()
     eval_step = make_eval_step(model.module, specs=specs)
 
+    def audit_program(edge: int = 64):
+        """``az_analyze --program`` hook: every tier dispatches this ONE
+        annotated forward (tiers differ only in host-side decode), so
+        each rung exposes it with shape-only example args."""
+        B = specs.data_axis_size if specs is not None else 1
+        return (eval_step,
+                (model.variables,
+                 jax.ShapeDtypeStruct((B, edge, param.n_mels),
+                                      jnp.float32)),
+                ())
+
     def forward_with(decode: Callable[[np.ndarray], str]):
         def forward(batch: Dict) -> List[str]:
             feats = batch["input"]
@@ -753,7 +770,8 @@ def ds2_serving_tiers(model: Model, param: Optional[DS2Param] = None,
 
     if param.decoder == "greedy":
         return [ServingTier("greedy", forward_with(best_path_decode),
-                            speed=1.0, quality_note="best-path decode")]
+                            speed=1.0, quality_note="best-path decode",
+                            device_program=audit_program)]
     width = param.beam_width
     low = degraded_beam if degraded_beam is not None else max(4, width // 4)
     return [
@@ -761,14 +779,17 @@ def ds2_serving_tiers(model: Model, param: Optional[DS2Param] = None,
                     forward_with(lambda lp: beam_search_decode(
                         lp, beam_width=width)),
                     speed=1.0,
-                    quality_note=f"prefix beam search, width {width}"),
+                    quality_note=f"prefix beam search, width {width}",
+                    device_program=audit_program),
         ServingTier(f"beam{low}",
                     forward_with(lambda lp: beam_search_decode(
                         lp, beam_width=low)),
                     speed=0.85,
                     quality_note=f"reduced beam width {low} (bounded "
-                                 "WER cost under overload)"),
+                                 "WER cost under overload)",
+                    device_program=audit_program),
         ServingTier("greedy", forward_with(best_path_decode), speed=0.7,
                     quality_note="best-path decode (no beam) — the "
-                                 "cheapest rung"),
+                                 "cheapest rung",
+                    device_program=audit_program),
     ]

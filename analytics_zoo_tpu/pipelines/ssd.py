@@ -14,7 +14,6 @@ from __future__ import annotations
 import dataclasses
 import functools
 import logging
-import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -640,17 +639,22 @@ class Validator:
     def __init__(self, model: Model, param: PreProcessParam,
                  evaluator: Optional[MeanAveragePrecision] = None,
                  post: Optional[DetectionOutputParam] = None,
-                 quantize=False):
+                 quantize=False, clock=None):
         """``quantize`` forwards to :class:`SSDPredictor` — evaluate the
-        int8 serving modes with the same Validator the fp path uses."""
+        int8 serving modes with the same Validator the fp path uses.
+        ``clock``: injected time source for the throughput log (utils.
+        clock convention — the one-clock rule bans raw time.time)."""
+        from analytics_zoo_tpu.utils.clock import as_now_fn
+
         self.predictor = SSDPredictor(model, param, post=post,
                                       quantize=quantize)
         self.evaluator = evaluator or MeanAveragePrecision()
+        self._now = as_now_fn(clock)
 
     def test(self, dataset) -> DetectionResult:
         total: Optional[DetectionResult] = None
         n_records = 0
-        t0 = time.time()
+        t0 = self._now()
 
         def dispatch(batch):
             nonlocal n_records
@@ -666,7 +670,7 @@ class Validator:
         # dispatch-ahead window: the next batches' forwards overlap this
         # one's readback + host-side eval
         overlap_window(dataset, dispatch, consume)
-        dt = time.time() - t0
+        dt = self._now() - t0
         logger.info("[Prediction] %d in %.2f seconds. Throughput is %.2f "
                     "records/sec", n_records, dt, n_records / max(dt, 1e-9))
         return total
@@ -859,13 +863,34 @@ def ssd_serving_tiers(model: Model, param: PreProcessParam,
             return np.asarray(pred.detect_normalized(batch["input"]))
         return forward
 
+    def audit(pred: SSDPredictor) -> Callable[[], tuple]:
+        """``az_analyze --program`` hook: the tier's actual jitted
+        detect program + shape-only example args (ShapeDtypeStructs —
+        the audit traces, it never dispatches)."""
+        def device_program():
+            B = (pred.specs.data_axis_size if pred.specs is not None
+                 else 1)
+            res = pred.param.resolution
+            variables = (pred._variables if pred._variables is not None
+                         else pred.model.variables)
+            S = jax.ShapeDtypeStruct
+            ones = S((B,), jnp.float32)
+            return (pred._detect,
+                    (variables, S((B, res, res, 3), jnp.float32),
+                     ones, ones, pred.post),
+                    (4,))
+        return device_program
+
     return [
         ServingTier("fp", fwd(full), speed=1.0,
-                    quality_note="full precision, full NMS top-K"),
+                    quality_note="full precision, full NMS top-K",
+                    device_program=audit(full)),
         ServingTier("int8", fwd(int8), speed=0.77,
                     quality_note="int8 weights, fp math (mAP delta "
-                                 "+0.0001, INT8_MAP_PARITY.json)"),
+                                 "+0.0001, INT8_MAP_PARITY.json)",
+                    device_program=audit(int8)),
         ServingTier(f"int8_topk{degraded_topk}", fwd(low), speed=0.7,
                     quality_note=f"int8 + keep_topk={degraded_topk} "
-                                 "(fewer kept detections per image)"),
+                                 "(fewer kept detections per image)",
+                    device_program=audit(low)),
     ]

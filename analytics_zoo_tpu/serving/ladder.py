@@ -40,12 +40,20 @@ class ServingTier:
     """Descriptor for one rung: a human-readable name, the per-replica
     forward callable factory's product (bound by the runtime), and the
     relative speed the batcher's service-time model may consult
-    (1.0 = tier-0 time; int8 < 1)."""
+    (1.0 = tier-0 time; int8 < 1).
+
+    ``device_program`` (optional): a zero-arg thunk returning ``(fn,
+    example_args, static_argnums)`` for the tier's underlying jitted
+    device program — what ``az_analyze --program`` traces, so the
+    static audit covers exactly the program this tier dispatches (the
+    ``forward`` callable itself is a host closure with decode loops and
+    cannot be traced)."""
 
     name: str
     forward: Callable[[Dict[str, Any]], Any]
     speed: float = 1.0
     quality_note: str = ""
+    device_program: Optional[Callable[[], tuple]] = None
 
 
 @dataclasses.dataclass

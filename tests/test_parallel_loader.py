@@ -26,11 +26,15 @@ from analytics_zoo_tpu.resilience.errors import PrefetchWorkerDied
 def _rng_ds():
     """Dataset whose stream exercises every RNG surface the loader must
     pin: source shuffle, a held-Random transformer, global random AND
-    global numpy draws."""
+    the loader-local numpy sample Generator (the sanctioned replacement
+    for global ``np.random`` draws — seeded-rng-only rule)."""
+    from analytics_zoo_tpu.data import sample_rng
+
     ds = DataSet.from_list(list(range(40)), shuffle=True, seed=4)
     aug = RandomTransformer(FnTransformer(lambda x: x + 1000), prob=0.5)
     noise = FnTransformer(
-        lambda x: (x, round(random.random(), 6), float(np.random.rand())))
+        lambda x: (x, round(random.random(), 6),
+                   float(sample_rng().random())))
     return (ds.transform(aug).transform(noise)
             .batch(8, collate_fn=lambda b: b, drop_remainder=False))
 

@@ -9,13 +9,12 @@ Three contracts pinned here, each guarding a refactor failure mode:
    kills).
 2. **Roundtrip identity** — ``place_state`` → ``gather`` on a 1-device
    mesh is byte-identical (placement must never rewrite values).
-3. **One placement site** — no pipeline or serving module constructs
-   device placement itself (``jax.device_put`` / ``NamedSharding(``):
-   the ISSUE-9 grep-clean acceptance gate as a test, so it cannot rot.
+3. **One placement site** — no module outside the spec substrate
+   constructs device placement itself (``jax.device_put`` /
+   ``NamedSharding(``): the ISSUE-9 acceptance gate, enforced since
+   ISSUE 10 by az-analyze's ``one-placement-site`` AST rule (package-
+   wide, waivers visible and reasoned) so it cannot rot.
 """
-
-import os
-import re
 
 import numpy as np
 import pytest
@@ -244,24 +243,40 @@ class TestAnnotatedStep:
 
 
 class TestOnePlacementSite:
-    def test_no_ad_hoc_placement_in_pipelines_or_serving(self):
-        """ISSUE-9 acceptance gate: entry points consume the spec layer;
-        they never construct device placement themselves."""
-        root = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "analytics_zoo_tpu")
-        banned = re.compile(r"(jax\.)?device_put\(|NamedSharding\(")
-        offenders = []
-        for pkg in ("pipelines", "serving"):
-            pkg_dir = os.path.join(root, pkg)
-            for fname in sorted(os.listdir(pkg_dir)):
-                if not fname.endswith(".py"):
-                    continue
-                with open(os.path.join(pkg_dir, fname)) as f:
-                    for lineno, line in enumerate(f, 1):
-                        if banned.search(line):
-                            offenders.append(f"{pkg}/{fname}:{lineno}: "
-                                             f"{line.strip()}")
+    """ISSUE-9 acceptance gate, now enforced by az-analyze's
+    ``one-placement-site`` AST rule (ISSUE 10) — package-wide instead of
+    two directories, alias-aware, docstring-proof, and with visible
+    reasoned waivers instead of silent exemptions."""
+
+    def test_no_unwaived_placement_outside_spec_layer(self):
+        from analytics_zoo_tpu.analysis.source import (OnePlacementSite,
+                                                       run_source_engine)
+
+        violations = run_source_engine(rules=[OnePlacementSite()])
+        offenders = [v for v in violations if not v.waived]
         assert not offenders, (
             "device placement outside the spec layer (declare specs in "
-            "parallel/specs.py and consume them instead):\n"
-            + "\n".join(offenders))
+            "parallel/specs.py and consume them, or waive with a "
+            "reason):\n" + "\n".join(
+                f"{v.file}:{v.line} {v.message}" for v in offenders))
+        # every surviving exception is a visible, reasoned waiver
+        for v in violations:
+            if v.waived:
+                assert v.waiver_reason
+
+    def test_rule_fires_on_seeded_violation(self, tmp_path):
+        """The rule must actually detect ad-hoc placement — pin it on a
+        fixture so a rule refactor can't silently go blind."""
+        from analytics_zoo_tpu.analysis.source import (OnePlacementSite,
+                                                       run_source_engine)
+
+        (tmp_path / "rogue.py").write_text(
+            "import jax\n"
+            "from jax.sharding import NamedSharding, PartitionSpec\n\n"
+            "def place(x, mesh):\n"
+            "    s = NamedSharding(mesh, PartitionSpec('data'))\n"
+            "    return jax.device_put(x, s)\n")
+        got = run_source_engine(root=str(tmp_path),
+                                rules=[OnePlacementSite()])
+        lines = {v.line for v in got}
+        assert {5, 6} <= lines and not any(v.waived for v in got)
