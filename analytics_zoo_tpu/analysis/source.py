@@ -388,9 +388,123 @@ class TaxonomyComplete:
                             f"exception class defined in this module")
 
 
+@dataclasses.dataclass
+class RegisteredMetricNames:
+    """Every ``registry.counter/gauge/histogram`` name used anywhere in
+    the package must be declared once in the ``obs/names.py`` catalog —
+    the registry accepts free-form strings, which is exactly how five
+    generations of telemetry names drifted apart before PR 7.  The rule
+    resolves statically: a literal name (or an f-string whose leading
+    literal prefix pins the family, e.g. ``f"serve/latency_s/tier=
+    {tier}"`` → ``serve/latency_s/tier=*``) must be covered by a
+    catalog entry; a fully caller-parameterized name cannot be checked
+    here and needs a reasoned ``# az-allow:`` waiver naming the
+    canonical family it registers under (the standard waiver contract —
+    the exemption is visible at the call site, and the catalog still
+    documents the family).
+
+    The catalog is read from the INSTALLED package's ``obs/names.py``
+    by AST (``CATALOG`` dict-literal keys) — never imported, per the
+    engine's no-execution discipline — so fixture scans of other roots
+    still check against the real declaration."""
+
+    name: str = "registered-metric-names"
+    allowed: FrozenSet[str] = frozenset({
+        "obs/registry.py",   # the substrate itself (names are params)
+        "obs/names.py",      # the declaration
+    })
+    _METHODS = frozenset({"counter", "gauge", "histogram"})
+
+    def _catalog(self) -> FrozenSet[str]:
+        cached = getattr(self, "_catalog_cache", None)
+        if cached is not None:
+            return cached
+        path = os.path.join(package_root(), "obs", "names.py")
+        patterns: List[str] = []
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+            for node in ast.walk(tree):
+                target = None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    target = node.targets[0].id
+                elif isinstance(node, ast.AnnAssign) \
+                        and isinstance(node.target, ast.Name):
+                    target = node.target.id
+                if target != "CATALOG" or not isinstance(node.value,
+                                                         ast.Dict):
+                    continue
+                for key in node.value.keys:
+                    if isinstance(key, ast.Constant) \
+                            and isinstance(key.value, str):
+                        patterns.append(key.value)
+        except (OSError, SyntaxError):   # pragma: no cover - repo intact
+            pass
+        out = frozenset(patterns)
+        self._catalog_cache = out
+        return out
+
+    @staticmethod
+    def _static_name(arg: ast.AST):
+        """(resolved-name-or-pattern, fully_static) from the first call
+        argument; (None, False) when no literal prefix exists."""
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value, True
+        if isinstance(arg, ast.JoinedStr):
+            prefix: List[str] = []
+            for part in arg.values:
+                if isinstance(part, ast.Constant) \
+                        and isinstance(part.value, str):
+                    prefix.append(part.value)
+                else:
+                    break
+            p = "".join(prefix)
+            return (p + "*", False) if p else (None, False)
+        return None, False
+
+    def _covered(self, name: str) -> bool:
+        cat = self._catalog()
+        if name in cat:
+            return True
+        if name.endswith("*"):
+            p = name[:-1]
+            return any(c.endswith("*") and p.startswith(c[:-1])
+                       for c in cat)
+        return any(c.endswith("*") and name.startswith(c[:-1])
+                   for c in cat)
+
+    def check(self, ctx: ModuleContext) -> Iterator[Violation]:
+        if ctx.rel in self.allowed:
+            return
+        for call in _calls(ctx.tree):
+            if not isinstance(call.func, ast.Attribute) \
+                    or call.func.attr not in self._METHODS:
+                continue
+            if not call.args:
+                continue
+            resolved, _ = self._static_name(call.args[0])
+            if resolved is None:
+                yield Violation(
+                    rule=self.name, file=ctx.display, line=call.lineno,
+                    message=f".{call.func.attr}( name is not statically "
+                            f"resolvable — declare the canonical family "
+                            f"in obs/names.py and waive this "
+                            f"caller-parameterized site with the family "
+                            f"it registers under")
+            elif not self._covered(resolved):
+                yield Violation(
+                    rule=self.name, file=ctx.display, line=call.lineno,
+                    message=f"metric name {resolved!r} is not declared "
+                            f"in the obs/names.py catalog — declare it "
+                            f"(name, kind, one-line meaning) so the "
+                            f"registry namespace stays documented")
+
+
 def default_rules() -> List:
     return [OneClock(), OnePlacementSite(), SeededRngOnly(),
-            NoHostSyncInHotPath(), TaxonomyComplete()]
+            NoHostSyncInHotPath(), TaxonomyComplete(),
+            RegisteredMetricNames()]
 
 
 #: name → rule instance (the default catalog the CLI runs).

@@ -51,6 +51,7 @@ class ReadStats:
         is the source of truth and ``publish`` may be called repeatedly
         (e.g. once per epoch) without double counting."""
         for field in dataclasses.fields(self):
+            # az-allow: registered-metric-names — prefix-parameterized mirror; the canonical data/read/* family is declared in obs/names.py
             registry.gauge(f"{prefix}/{field.name}").set(
                 getattr(self, field.name))
 

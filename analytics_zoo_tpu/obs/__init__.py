@@ -20,7 +20,17 @@ instrumentation are the pattern sources):
 - :mod:`probe` — :class:`StepProbe`: the dispatch / device /
   input-wait step decomposition as a reusable API;
 - :mod:`runmeta` — :func:`run_metadata`: the artifact-stamping block
-  ``tools/check_artifacts.py`` lints for.
+  ``tools/check_artifacts.py`` lints for;
+- :mod:`trace` — :class:`TraceStore`: indexed span trees over a flight
+  recording, critical-path extraction, p99-vs-p50 tail attribution
+  (``tools/az_trace.py`` is the CLI);
+- :mod:`slo` — :class:`SLO`/:class:`SloEvaluator`: declarative
+  objectives over registry snapshots with multi-window burn-rate
+  alerting; drives the serving DegradationLadder and the ROADMAP
+  item-1 autoscaler hook;
+- :mod:`names` — :data:`CATALOG`: every registry metric name declared
+  once (the ``registered-metric-names`` az-analyze rule pins usage
+  against it).
 
 Everything runs on the injected clock (``utils.clock``), so drills on a
 ``VirtualClock`` produce byte-identical traces from a seed
@@ -40,8 +50,16 @@ from analytics_zoo_tpu.obs.probe import StepProbe
 from analytics_zoo_tpu.obs.recorder import DEFAULT_CAPACITY, FlightRecorder
 from analytics_zoo_tpu.obs.registry import (Counter, Gauge, MetricRegistry,
                                             ReservoirHistogram)
+from analytics_zoo_tpu.obs.names import CATALOG
 from analytics_zoo_tpu.obs.runmeta import run_metadata
+from analytics_zoo_tpu.obs.slo import (SLO, SloDecision, SloEvaluator,
+                                       deadline_miss_slo,
+                                       default_serving_slos,
+                                       p99_latency_slo, shed_rate_slo)
 from analytics_zoo_tpu.obs.span import Span, Tracer, span_conservation
+from analytics_zoo_tpu.obs.trace import (SEGMENTS, TraceStore,
+                                         attribution_rows,
+                                         format_critical_path)
 from analytics_zoo_tpu.utils.clock import TimeSource
 
 
@@ -87,6 +105,7 @@ class Observability:
 
 
 __all__ = [
+    "CATALOG",
     "Counter",
     "DEFAULT_CAPACITY",
     "FlightRecorder",
@@ -94,12 +113,23 @@ __all__ = [
     "MetricRegistry",
     "Observability",
     "ReservoirHistogram",
+    "SEGMENTS",
+    "SLO",
+    "SloDecision",
+    "SloEvaluator",
     "Span",
     "StepProbe",
     "SummaryBridge",
+    "TraceStore",
     "Tracer",
+    "attribution_rows",
+    "deadline_miss_slo",
+    "default_serving_slos",
     "dump_flight_jsonl",
+    "format_critical_path",
+    "p99_latency_slo",
     "render_prometheus",
     "run_metadata",
+    "shed_rate_slo",
     "span_conservation",
 ]

@@ -33,13 +33,21 @@ def dump_flight_jsonl(recorder: FlightRecorder, path: str,
     return recorder.dump(reason, path=path)
 
 
+def _escape_label(v: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline are the three characters the spec escapes —
+    anything else passes through verbatim."""
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _prom_name(name: str) -> Tuple[str, str]:
     """Split a registry name into (prometheus_name, label_block)."""
     parts = name.split("/")
     labels = []
     while parts and "=" in parts[-1]:
         k, v = parts.pop().split("=", 1)
-        labels.append((_NAME_RE.sub("_", k), v.replace('"', "'")))
+        labels.append((_NAME_RE.sub("_", k), _escape_label(v)))
     base = _NAME_RE.sub("_", "_".join(parts)) or "metric"
     if base[0].isdigit():
         base = "_" + base
@@ -65,8 +73,24 @@ def render_prometheus(registry: MetricRegistry) -> str:
     # family (base, kind) -> sample lines, first-seen order (registry
     # iteration is name-sorted, so label variants arrive together)
     families: "dict[tuple, List[str]]" = {}
+    # sanitization is lossy ("-" and "_" both become "_") and the
+    # per-kind suffixes (_total/_sum/_count) can alias a neighbor's
+    # base: two DISTINCT registry names landing on the same EMITTED
+    # series would merge silently on the scrape side, so collisions are
+    # checked on the sample names each metric actually emits
+    _EMITTED = {"counter": ("_total",), "gauge": ("",),
+                "histogram": ("", "_sum", "_count")}
+    seen: "dict[Tuple[str, str], str]" = {}
     for name, m in registry.metrics().items():
         base, labels = _prom_name(name)
+        for suffix in _EMITTED[m.kind]:
+            prior = seen.setdefault((base + suffix, labels), name)
+            if prior != name:
+                raise ValueError(
+                    f"prometheus name collision: registry names "
+                    f"{prior!r} and {name!r} both emit the series "
+                    f"{base + suffix}{labels or ''} — rename one "
+                    f"(sanitization must stay injective per sample)")
         fam = families.setdefault((base, m.kind), [])
         if m.kind == "counter":
             fam.append(f"{base}_total{labels} {m.value}")

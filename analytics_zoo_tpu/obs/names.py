@@ -1,0 +1,109 @@
+"""Central metric-name catalog: every registry name, declared once.
+
+The registry accepts free-form names, which is how five generations of
+ad-hoc telemetry drifted apart in the first place.  This module is the
+single source of truth: every ``registry.counter/gauge/histogram`` name
+used anywhere in the package is declared here with its kind and one
+line of meaning.  Three consumers pin against it:
+
+- the ``registered-metric-names`` az-analyze source rule
+  (``analysis/source.py``) — a call site registering an undeclared
+  name fails tier-1 (dynamic, caller-parameterized names carry a
+  reasoned ``# az-allow:`` waiver at the call site and declare their
+  canonical families here);
+- the docs table (``docs/OBSERVABILITY.md`` "What registers into it
+  today") — ``tests/test_obs.py`` pins table ⇄ catalog equality, so
+  the documentation cannot drift from the declaration;
+- humans adding a metric: declare it here first, with the name
+  convention ``<subsystem>/<metric>[/k=v...]`` (trailing ``k=v``
+  segments become Prometheus labels; a trailing ``*`` in a catalog
+  entry marks the labeled-family wildcard).
+
+Entries map name (or ``...=*`` family pattern) → ``"<kind> · <doc>"``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+CATALOG: Dict[str, str] = {
+    # -- serving (ServingMetrics, fed by ServingRuntime) --------------------
+    "serve/submitted":
+        "counter · requests submitted to the runtime (admitted or shed "
+        "at the door)",
+    "serve/completed":
+        "counter · requests that reached a device and returned a result",
+    "serve/failed":
+        "counter · requests failed after exhausting replica failover",
+    "serve/batches":
+        "counter · batches dispatched to the replica pool",
+    "serve/redispatches":
+        "counter · batches re-dispatched exactly once after a replica "
+        "fence",
+    "serve/deadline_misses_completed_late":
+        "counter · completed requests whose result landed past the "
+        "deadline",
+    "serve/shed/cause=*":
+        "counter · requests shed before device dispatch, by cause "
+        "(queue_full | deadline)",
+    "serve/latency_s/tier=*":
+        "histogram · end-to-end request latency per degradation tier",
+    "serve/batch_fill":
+        "histogram · dispatched-batch fill fraction (n_valid/max_batch)",
+    "serve/queue_depth":
+        "histogram · admission-queue depth sampled at each dispatch",
+    # -- SLO engine (obs.slo.SloEvaluator(registry=)) -----------------------
+    "slo/fast_burn/slo=*":
+        "gauge · latest fast-window burn rate per SLO (1.0 = budget "
+        "consumed exactly at the sustainable rate)",
+    "slo/slow_burn/slo=*":
+        "gauge · latest slow-window burn rate per SLO",
+    "slo/trips/slo=*":
+        "counter · rising-edge transitions into burning per SLO (the "
+        "fast-window trips the drill banks)",
+    # -- training (Optimizer.set_observability) -----------------------------
+    "train/dispatch/step_s":
+        "histogram · host interval of the train-step call (async "
+        "dispatch latency, not fenced device wall)",
+    "train/dispatch/steps":
+        "counter · train steps dispatched",
+    "train/dispatch/records":
+        "counter · training records dispatched",
+    "train/anomaly/bad_steps":
+        "counter · steps the anomaly sentinel discarded in-graph",
+    "train/anomaly/rollbacks":
+        "counter · last-known-good rollbacks the anomaly ladder took",
+    "checkpoint/save_s":
+        "histogram · checkpoint save wall seconds (sha256-manifested "
+        "atomic publish)",
+    "checkpoint/restore_s":
+        "histogram · checkpoint restore wall seconds",
+    # -- data loading (ReadStats.publish) -----------------------------------
+    "data/read/records":
+        "gauge · records successfully yielded by resilient shard reads",
+    "data/read/retries":
+        "gauge · transient I/O errors retried",
+    "data/read/skipped_records":
+        "gauge · undecodable records dropped (skip-and-count)",
+    "data/read/skipped_shards":
+        "gauge · whole shards dropped after retry exhaustion",
+    # -- step decomposition probe (obs.StepProbe) ---------------------------
+    "probe/input_wait_s":
+        "histogram · per-step blocking time on the input pipeline",
+    "probe/dispatch_s":
+        "histogram · per-step host dispatch time (call until return)",
+    "probe/device_s":
+        "histogram · per-step device wait (return until "
+        "block_until_ready)",
+}
+
+
+def lookup(name: str) -> bool:
+    """Whether a concrete registry name is covered by the catalog —
+    exact entry, or a ``...=*`` family whose prefix matches."""
+    if name in CATALOG:
+        return True
+    for pattern in CATALOG:
+        if pattern.endswith("*") and name.startswith(pattern[:-1]):
+            return True
+    return False

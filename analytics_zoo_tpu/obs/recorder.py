@@ -28,6 +28,15 @@ from analytics_zoo_tpu.utils.clock import TimeSource, as_now_fn
 DEFAULT_CAPACITY = 8192
 
 
+def events_to_jsonl(events: Iterable[Dict[str, Any]]) -> str:
+    """THE flight-recording serialization: one sorted-keys JSON object
+    per line, in the given order.  Shared by the recorder's dump and
+    ``obs.trace.TraceStore.to_jsonl`` so their byte-identity (the
+    ingest↔export inverse every replay-sha pipeline leans on) holds by
+    construction, not by parallel copies."""
+    return "".join(json.dumps(e, sort_keys=True) + "\n" for e in events)
+
+
 class FlightRecorder:
     """Fixed-capacity event ring.
 
@@ -77,10 +86,9 @@ class FlightRecorder:
         return list(evs)
 
     def to_jsonl(self) -> str:
-        """The ring as JSONL text: one sorted-keys JSON object per line,
-        in seq order (the deque is already oldest→newest)."""
-        return "".join(json.dumps(e, sort_keys=True) + "\n"
-                       for e in self._ring)
+        """The ring as JSONL text, in seq order (the deque is already
+        oldest→newest) — via the shared :func:`events_to_jsonl`."""
+        return events_to_jsonl(self._ring)
 
     def dump(self, reason: str, path: Optional[str] = None) -> str:
         """Serialize the ring; write to ``path`` (or the configured

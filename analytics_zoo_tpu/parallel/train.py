@@ -1063,12 +1063,22 @@ class Optimizer:
     def _graceful_preempt(self, loop: TrainingState, state: TrainState):
         """Step-boundary response to SIGTERM/SIGINT: force a final
         checkpoint, then raise the retryable ``Preempted`` so a
-        supervisor (or the job's next incarnation) resumes from it."""
+        supervisor (or the job's next incarnation) resumes from it.
+        Preemption is a terminal condition for THIS incarnation, so the
+        flight recorder dumps its ring alongside the boundary
+        checkpoint — the preemption drill carries a black box of the
+        steps leading into the signal, same as a divergence does."""
         from analytics_zoo_tpu.resilience.errors import Preempted
 
         saved = False
         if self.checkpoint_path is not None:
             saved = bool(self._maybe_checkpoint(loop, state, force=True))
+        if self.obs is not None:
+            self.obs.recorder.note(
+                "preempted", iteration=loop.iteration, epoch=loop.epoch,
+                checkpoint_saved=saved)
+            if self.obs.dump_path:
+                self.obs.dump("preempted")
         raise Preempted(
             f"preemption signal received at iteration {loop.iteration}; "
             + ("final checkpoint written"

@@ -124,6 +124,18 @@ class DegradationLadder:
                            action, self.tier, self.windows)
         return action
 
+    def observe_decision(self, decision,
+                         detail: Optional[Dict[str, Any]] = None) -> str:
+        """Feed one :class:`~analytics_zoo_tpu.obs.slo.SloDecision`
+        instead of a raw overloaded flag — the SLO-driven decision
+        input (PR 11): a window is overloaded when an SLO is *burning*
+        on both burn-rate windows, not merely when a shed happened.
+        The transition event records which SLOs drove it, so a banked
+        drill can show the step-down was SLO-attributed."""
+        d = {"slo_burning": list(decision.burning),
+             "scale_hint": decision.scale_hint, **(detail or {})}
+        return self.observe_window(decision.overloaded, detail=d)
+
     def snapshot(self) -> Dict[str, Any]:
         return {"tier": self.tier, "windows": self.windows,
                 "overloaded_streak": self.overloaded_streak,

@@ -75,6 +75,16 @@ class ServingRuntime:
     ChaosMonkey` whose serving-kind windows (``slow_forward``,
     ``replica_crash``) are applied per dispatch index.
 
+    ``slo``: an :class:`~analytics_zoo_tpu.obs.slo.SloEvaluator` —
+    when armed, every decision window feeds the metric registry's
+    snapshot through the multi-window burn-rate evaluation and the
+    degradation ladder steps on ``SloDecision.overloaded`` (SLO burn)
+    instead of the raw shed/queue-depth flag; each decision is noted
+    into the flight recorder (``slo_decision`` events) when ``obs`` is
+    armed, and ``snapshot()`` carries the SLO report.  The same
+    evaluator's ``scale_hint`` is the autoscaler input (ROADMAP
+    item 1).
+
     ``specs``: the pipeline's declared
     :class:`~analytics_zoo_tpu.parallel.specs.SpecSet` — pass the SAME
     object the tiers were built with (``ssd_serving_tiers(specs=...)``
@@ -99,7 +109,7 @@ class ServingRuntime:
                  ladder_policy: Optional[LadderPolicy] = None,
                  decision_every: int = 8,
                  shed_expired: bool = True,
-                 chaos=None, obs=None, specs=None):
+                 chaos=None, obs=None, specs=None, slo=None):
         if not tiers:
             raise ValueError("need at least one ServingTier")
         self.tiers = list(tiers)
@@ -109,6 +119,11 @@ class ServingRuntime:
         self.max_batch = int(max_batch)
         self.decision_every = int(decision_every)
         self.chaos = chaos
+        # SLO engine (obs.slo.SloEvaluator): when armed, each decision
+        # window feeds a registry snapshot through the multi-window
+        # burn-rate evaluation and the ladder steps on SLO burn instead
+        # of the raw shed/depth flag (see _decide_window)
+        self.slo = slo
         # telemetry spine (obs.Observability): request-lifecycle spans
         # into the flight recorder, metrics into the shared registry; a
         # replica fence dumps the black box when a dump_path is armed
@@ -334,12 +349,30 @@ class ServingRuntime:
             self._decide_window()
 
     def _decide_window(self) -> None:
-        depth_high = (self.ladder.policy.depth_high * self.max_batch)
-        overloaded = (self._window_shed > 0
-                      or self.queue.depth > depth_high)
-        self.ladder.observe_window(
-            overloaded, detail={"shed_in_window": self._window_shed,
-                                "queue_depth": self.queue.depth})
+        detail = {"shed_in_window": self._window_shed,
+                  "queue_depth": self.queue.depth}
+        if self.slo is not None:
+            # SLO-driven path: window verdicts come from multi-window
+            # burn rates over registry snapshots, not the raw flag —
+            # the decision itself lands in the black box (Clockwork:
+            # the action log explains the action)
+            now = self.clock.now()
+            self.slo.observe_registry(self.metrics.registry, now)
+            decision = self.slo.decide(now)
+            if self.obs is not None:
+                self.obs.recorder.note(
+                    "slo_decision", t=round(now, 6),
+                    overloaded=decision.overloaded,
+                    burning=list(decision.burning),
+                    new_trips=list(decision.new_trips),
+                    recovered=list(decision.recovered),
+                    scale_hint=decision.scale_hint)
+            self.ladder.observe_decision(decision, detail=detail)
+        else:
+            depth_high = self.ladder.policy.depth_high * self.max_batch
+            overloaded = (self._window_shed > 0
+                          or self.queue.depth > depth_high)
+            self.ladder.observe_window(overloaded, detail=detail)
         self._window_shed = 0
         self._since_decision = 0
 
@@ -364,7 +397,7 @@ class ServingRuntime:
                 "axes": dict(self.specs.mesh.shape),
                 "data_axis_size": self.specs.data_axis_size,
             }
-        return {
+        out = {
             "mesh": mesh_info,
             "metrics": self.metrics.snapshot(),
             "queue": self.queue.snapshot(),
@@ -375,3 +408,11 @@ class ServingRuntime:
                       for t in self.tiers],
             "accounting": self.accounting(),
         }
+        if self.slo is not None:
+            # keyed in only when armed, so pre-PR-11 snapshots (and the
+            # banked RESILIENCE_r03/OBS_r01 replays) are byte-unchanged
+            r = self.slo.report()
+            out["slo"] = {k: r[k] for k in
+                          ("slos", "windows", "decisions", "trips",
+                           "peak_burns")}
+        return out

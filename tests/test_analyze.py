@@ -43,6 +43,7 @@ from analytics_zoo_tpu.analysis.source import (
     NoHostSyncInHotPath,
     OneClock,
     OnePlacementSite,
+    RegisteredMetricNames,
     SeededRngOnly,
     TaxonomyComplete,
     default_rules,
@@ -223,6 +224,60 @@ class TestTaxonomyCompleteRule:
             "_RETRYABLE_CLASSES: Tuple[Type[BaseException], ...] = (A,)\n"
             "FATAL_ERRORS = (B,)\n"), self.RULES)
         assert got == []
+
+
+class TestRegisteredMetricNamesRule:
+    RULES = [RegisteredMetricNames()]
+
+    def test_fires_on_undeclared_static_prefixed_and_dynamic_names(
+            self, tmp_path):
+        got = _scan(tmp_path, "mod.py", (
+            "def f(reg, name, cause):\n"
+            "    reg.counter('made/up').inc()\n"              # undeclared
+            "    reg.gauge(f'serve/unknown_{cause}').set(1)\n"  # bad family
+            "    reg.histogram(name).observe(1.0)\n"),        # dynamic
+            self.RULES)
+        assert {v.line for v in got} == {2, 3, 4}
+        assert all(v.rule == "registered-metric-names" for v in got)
+        assert any("'made/up'" in v.message for v in got)
+        assert any("'serve/unknown_*'" in v.message for v in got)
+        assert any("not statically resolvable" in v.message for v in got)
+
+    def test_clean_on_declared_names_families_and_waived_dynamics(
+            self, tmp_path):
+        got = _scan(tmp_path, "mod.py", (
+            "def f(reg, name, cause, tier):\n"
+            "    reg.counter('serve/submitted').inc()\n"
+            "    reg.counter(f'serve/shed/cause={cause}').inc()\n"
+            "    reg.histogram(f'serve/latency_s/tier={tier}')"
+            ".observe(0.1)\n"
+            "    reg.counter('serve/shed/cause=deadline').inc()\n"
+            "    reg.gauge(name).set(1)  "
+            "# az-allow: registered-metric-names — caller passes a "
+            "declared data/read/* name\n"), self.RULES)
+        assert _unwaived(got) == []
+
+    def test_substrate_and_catalog_modules_are_exempt(self, tmp_path):
+        (tmp_path / "obs").mkdir()
+        (tmp_path / "obs" / "registry.py").write_text(
+            "def counter(self, name):\n"
+            "    return self._get(name)\n"
+            "def snapshot(reg, name):\n"
+            "    return reg.counter(name).value\n")
+        got = run_source_engine(root=str(tmp_path), rules=self.RULES)
+        assert got == []
+
+    def test_catalog_loaded_from_the_real_package_by_ast(self):
+        """The rule reads obs/names.py without importing it; its view
+        must match the live CATALOG exactly."""
+        from analytics_zoo_tpu.obs.names import CATALOG
+
+        rule = RegisteredMetricNames()
+        assert rule._catalog() == frozenset(CATALOG)
+        assert rule._covered("serve/submitted")
+        assert rule._covered("serve/shed/cause=deadline")
+        assert rule._covered("serve/shed/cause=*")
+        assert not rule._covered("made/up")
 
 
 # ---------------------------------------------------------------------------

@@ -319,6 +319,40 @@ class TestChaosMatrix:
         state = cp.load(ckpt)
         assert int(np.asarray(state["step"])) == 12
 
+    def test_sigterm_preemption_dumps_flight_recorder(self, tmp_path):
+        """The graceful preemption path is a terminal condition for the
+        incarnation, so it dumps the black box (reason ``preempted``)
+        alongside the boundary checkpoint — the preemption drill
+        carries the spans leading into the signal."""
+        import json
+
+        from analytics_zoo_tpu.obs import Observability
+
+        ckpt = str(tmp_path / "ckpt")
+        box = str(tmp_path / "flight.jsonl")
+        data = _dataset(n_batches=4)
+        monkey = ChaosMonkey([FaultSpec("sigterm", 2)],
+                             checkpoint_path=ckpt)
+        chaos_data = monkey.dataset(data)
+        obs = Observability(capacity=512, dump_path=box)
+
+        def build():
+            return (self._build(chaos_data, ckpt)
+                    .set_preemption_handler()
+                    .set_observability(obs))
+
+        run_resilient(build, ckpt, max_restarts=3)
+        assert any(d["reason"] == "preempted"
+                   for d in obs.recorder.dumps), obs.recorder.dumps
+        notes = obs.recorder.events("preempted")
+        assert len(notes) == 1 and notes[0]["checkpoint_saved"] is True
+        dumped = [json.loads(ln) for ln in open(box).read().splitlines()]
+        assert any(e.get("kind") == "preempted" for e in dumped)
+        # the ring carries the train-step spans leading into the signal
+        assert any(e.get("kind") == "span"
+                   and str(e.get("trace", "")).startswith("train-e")
+                   for e in dumped)
+
     def test_stall_watchdog_raises_instead_of_hanging(self, tmp_path):
         """A step exceeding the watchdog deadline raises StallError (a
         retryable) rather than blocking optimize() forever."""

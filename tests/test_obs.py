@@ -474,3 +474,151 @@ class TestTrainingIntegration:
         assert len(bad) == 1 and bad[0]["trace"] == "train-e0-b1"
         assert bad[0]["attrs"]["action"] == "skipped"
         assert obs.registry.counter("train/anomaly/bad_steps").value == 1
+
+
+class TestPrometheusEdgeCases:
+    """render_prometheus must survive the exposition format's sharp
+    edges: label escaping, lossy name sanitization, empty reservoirs."""
+
+    def test_label_values_needing_escaping(self):
+        reg = MetricRegistry()
+        reg.counter('serve/shed/cause=say "no" to back\\slash').inc(2)
+        text = render_prometheus(reg)
+        # prometheus text format: \\ then \" inside the quoted value
+        assert 'cause="say \\"no\\" to back\\\\slash"' in text
+        assert text.count("# TYPE serve_shed_total counter") == 1
+
+    def test_newline_in_label_value_escaped(self):
+        reg = MetricRegistry()
+        reg.counter("serve/shed/cause=two\nlines").inc()
+        text = render_prometheus(reg)
+        assert 'cause="two\\nlines"' in text
+        # the rendered exposition must stay one sample per line
+        lines = [ln for ln in text.splitlines() if "cause=" in ln]
+        assert len(lines) == 1
+
+    def test_sanitization_collision_must_not_silently_merge(self):
+        """Two registry names that sanitize to the same Prometheus
+        name (`-` and `_` both become `_`) are an error, not a silent
+        double-sample the scrape side would merge."""
+        reg = MetricRegistry()
+        reg.counter("serve/lat-s").inc()
+        reg.counter("serve/lat_s").inc()
+        with pytest.raises(ValueError, match="collision"):
+            render_prometheus(reg)
+
+    def test_label_variants_of_one_family_do_not_collide(self):
+        reg = MetricRegistry()
+        reg.histogram("serve/latency_s/tier=0").observe(0.1)
+        reg.histogram("serve/latency_s/tier=1").observe(0.2)
+        text = render_prometheus(reg)
+        assert text.count("# TYPE serve_latency_s summary") == 1
+        assert 'tier="0"' in text and 'tier="1"' in text
+
+    def test_empty_reservoir_histogram_renders_nan_quantiles(self):
+        reg = MetricRegistry()
+        reg.histogram("train/dispatch/step_s")     # never observed
+        text = render_prometheus(reg)
+        assert 'quantile="0.5"} NaN' in text
+        assert 'quantile="0.99"} NaN' in text
+        assert "train_dispatch_step_s_count 0" in text
+        assert "train_dispatch_step_s_sum 0.0" in text
+
+
+class TestMetricCatalog:
+    """obs/names.py is the one declaration of the registry namespace:
+    the docs table pins against it, and every name the live subsystems
+    register resolves in it."""
+
+    def _doc_names(self):
+        import re
+
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "docs", "OBSERVABILITY.md")
+        with open(path, encoding="utf-8") as f:
+            doc = f.read()
+        names = set()
+        for line in doc.splitlines():
+            if not line.lstrip().startswith("|"):
+                continue
+            for tok in re.findall(r"`([^`]+)`", line):
+                if "/" in tok and " " not in tok \
+                        and not tok.endswith((".py", ".md")):
+                    names.add(tok)
+        return names
+
+    def test_docs_names_table_matches_the_catalog_exactly(self):
+        from analytics_zoo_tpu.obs.names import CATALOG
+
+        doc = self._doc_names()
+        cat = set(CATALOG)
+        assert doc - cat == set(), \
+            f"documented but undeclared: {sorted(doc - cat)}"
+        assert cat - doc == set(), \
+            f"declared but undocumented: {sorted(cat - doc)}"
+
+    def test_catalog_entries_are_well_formed(self):
+        import re
+
+        from analytics_zoo_tpu.obs.names import CATALOG
+
+        for name, doc in CATALOG.items():
+            assert re.fullmatch(r"[a-z][a-z0-9_/=*.-]*", name), name
+            assert "/" in name, f"{name}: no subsystem prefix"
+            kind = doc.split("·")[0].strip()
+            assert kind in ("counter", "gauge", "histogram"), (name, doc)
+
+    def test_live_serving_and_slo_names_resolve_in_catalog(self):
+        from analytics_zoo_tpu.obs.names import lookup
+        from analytics_zoo_tpu.obs.slo import SloEvaluator, shed_rate_slo
+        from analytics_zoo_tpu.serving.metrics import ServingMetrics
+
+        reg = MetricRegistry()
+        m = ServingMetrics(registry=reg)
+        m.on_submit()
+        m.on_shed("deadline")
+        m.on_complete(0.1, tier=1, missed=True)
+        m.on_fail()
+        m.on_batch(2, 4, 1)
+        m.redispatches = 1
+        ev = SloEvaluator([shed_rate_slo(0.1)], fast_window_s=1,
+                          slow_window_s=10, registry=reg)
+        ev.observe(reg.snapshot(), t=0.0)
+        ev.decide(t=0.0)
+        for name in reg.metrics():
+            assert lookup(name), f"unregistered metric name: {name}"
+
+    def test_lookup_covers_exact_and_family_names(self):
+        from analytics_zoo_tpu.obs.names import lookup
+
+        assert lookup("serve/submitted")
+        assert lookup("serve/shed/cause=queue_full")      # family
+        assert not lookup("serve/submittedx")
+        assert not lookup("made/up")
+
+
+class TestPrometheusSuffixCollisions:
+    def test_counter_total_suffix_collision_with_gauge_raises(self):
+        """Review fix: collisions are checked on EMITTED series names —
+        counter 'train/steps' renders train_steps_total, which a gauge
+        named 'train/steps_total' would silently duplicate."""
+        reg = MetricRegistry()
+        reg.counter("train/steps").inc()
+        reg.gauge("train/steps_total").set(1)
+        with pytest.raises(ValueError, match="collision"):
+            render_prometheus(reg)
+
+    def test_histogram_sum_suffix_collision_raises(self):
+        reg = MetricRegistry()
+        reg.histogram("x/y").observe(1.0)
+        reg.gauge("x/y_sum").set(2)
+        with pytest.raises(ValueError, match="collision"):
+            render_prometheus(reg)
+
+    def test_distinct_suffixed_names_still_render(self):
+        reg = MetricRegistry()
+        reg.counter("train/steps").inc()
+        reg.gauge("train/steps_now").set(1)
+        text = render_prometheus(reg)
+        assert "train_steps_total 1" in text
+        assert "train_steps_now 1.0" in text

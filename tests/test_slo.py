@@ -1,0 +1,395 @@
+"""SLO engine (obs.slo): declarative objectives, multi-window burn
+rates, and the ladder-via-SLO serving integration on a VirtualClock.
+
+The window math is pinned on hand-fed snapshot streams (exact
+fractions, exact burn rates, trip/recovery edges); the integration test
+drives a real ServingRuntime through overload and asserts the
+degradation ladder steps on SLO burn — with the decision evidence in
+the flight recorder.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from analytics_zoo_tpu.obs import MetricRegistry, Observability
+from analytics_zoo_tpu.obs.slo import (SLO, SloEvaluator,
+                                       deadline_miss_slo,
+                                       default_serving_slos,
+                                       p99_latency_slo, shed_rate_slo)
+from analytics_zoo_tpu.utils.clock import VirtualClock
+
+
+def snap(counters=None, histograms=None):
+    return {"counters": dict(counters or {}), "gauges": {},
+            "histograms": dict(histograms or {})}
+
+
+def shed_ev(**kw):
+    """Evaluator over one shed-rate SLO (budget 0.1) with 10 s fast /
+    100 s slow windows — numbers chosen so window fractions are exact
+    decimals."""
+    kw.setdefault("fast_window_s", 10.0)
+    kw.setdefault("slow_window_s", 100.0)
+    return SloEvaluator([shed_rate_slo(0.1)], **kw)
+
+
+class TestSloDeclarations:
+    def test_kind_budget_and_field_validation(self):
+        with pytest.raises(ValueError, match="unknown kind"):
+            SLO("x", "percentile", 0.1)
+        with pytest.raises(ValueError, match="budget"):
+            SLO("x", "ratio", 0.0, bad=("a",), total=("b",))
+        with pytest.raises(ValueError, match="bad= and total="):
+            SLO("x", "ratio", 0.1)
+        with pytest.raises(ValueError, match="histogram-pattern"):
+            SLO("x", "threshold", 0.1, value="no-field-separator")
+
+    def test_factories_and_defaults(self):
+        slos = default_serving_slos()
+        assert [s.name for s in slos] == ["deadline-miss-rate",
+                                          "shed-rate", "p99-latency"]
+        assert deadline_miss_slo(0.3).budget == 0.3
+        assert p99_latency_slo(0.5).value == "serve/latency_s/tier=*:p99"
+
+    def test_evaluator_validation(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            SloEvaluator([shed_rate_slo(0.1), shed_rate_slo(0.2)])
+        with pytest.raises(ValueError, match="at least one"):
+            SloEvaluator([])
+        with pytest.raises(ValueError, match="time_scale"):
+            SloEvaluator([shed_rate_slo(0.1)], time_scale=0)
+        with pytest.raises(ValueError, match="shorter"):
+            SloEvaluator([shed_rate_slo(0.1)], fast_window_s=100,
+                         slow_window_s=100)
+
+    def test_time_scale_shrinks_both_windows(self):
+        ev = SloEvaluator([shed_rate_slo(0.1)], fast_window_s=300,
+                          slow_window_s=3600, time_scale=0.01)
+        assert ev.fast_window_s == pytest.approx(3.0)
+        assert ev.slow_window_s == pytest.approx(36.0)
+        rep = ev.report()
+        assert rep["windows"]["fast_equivalent_s"] == pytest.approx(300)
+        assert rep["windows"]["slow_equivalent_s"] == pytest.approx(3600)
+
+
+class TestWindowedRatioMath:
+    def test_fast_and_slow_windows_compute_distinct_fractions(self):
+        ev = shed_ev()
+        ev.observe(snap({"serve/submitted": 0}), t=0.0)
+        ev.observe(snap({"serve/submitted": 100,
+                         "serve/shed/cause=deadline": 0}), t=10.0)
+        ev.observe(snap({"serve/submitted": 200,
+                         "serve/shed/cause=deadline": 50}), t=20.0)
+        d = ev.decide(t=20.0)
+        p = d.per_slo["shed-rate"]
+        # fast window [10, 20]: 50 sheds over 100 submits -> 0.5, 5x
+        assert p["fast"]["fraction"] == pytest.approx(0.5)
+        assert p["fast"]["burn"] == pytest.approx(5.0)
+        # slow window [-80, 20] baseline is pre-attach zero: 50/200
+        assert p["slow"]["fraction"] == pytest.approx(0.25)
+        assert p["slow"]["burn"] == pytest.approx(2.5)
+        assert d.overloaded and d.new_trips == ["shed-rate"]
+
+    def test_wildcard_bad_patterns_sum_every_cause(self):
+        ev = shed_ev()
+        ev.observe(snap({"serve/submitted": 0}), t=0.0)
+        ev.observe(snap({"serve/submitted": 100,
+                         "serve/shed/cause=deadline": 10,
+                         "serve/shed/cause=queue_full": 20}), t=10.0)
+        d = ev.decide(t=10.0)
+        assert d.per_slo["shed-rate"]["fast"]["fraction"] == \
+            pytest.approx(0.3)
+
+    def test_no_traffic_in_window_is_not_a_burn(self):
+        ev = shed_ev()
+        ev.observe(snap({"serve/submitted": 100,
+                         "serve/shed/cause=deadline": 50}), t=0.0)
+        # no further traffic: fast window [90, 100] sees zero delta
+        ev.observe(snap({"serve/submitted": 100,
+                         "serve/shed/cause=deadline": 50}), t=100.0)
+        d = ev.decide(t=100.0)
+        p = d.per_slo["shed-rate"]
+        assert p["fast"]["fraction"] is None
+        assert p["fast"]["burn"] == 0.0
+        assert not d.overloaded
+
+    def test_empty_evaluator_decides_clean(self):
+        d = shed_ev().decide(t=0.0)
+        assert not d.overloaded
+        assert d.per_slo["shed-rate"]["fast"]["burn"] == 0.0
+
+    def test_observations_must_move_forward(self):
+        ev = shed_ev()
+        ev.observe(snap({"serve/submitted": 1}), t=5.0)
+        with pytest.raises(ValueError, match="forward"):
+            ev.observe(snap({"serve/submitted": 2}), t=4.0)
+
+    def test_prune_keeps_the_window_baseline(self):
+        """Observations far older than the slow window are dropped, but
+        the newest at-or-before the window start survives as the delta
+        baseline — the windowed fraction must not jump when history is
+        collected."""
+        ev = shed_ev()
+        for i in range(50):
+            ev.observe(snap({"serve/submitted": 10 * i,
+                             "serve/shed/cause=deadline": i}), t=10.0 * i)
+        assert len(ev._obs) < 50        # pruned
+        d = ev.decide(t=490.0)
+        p = d.per_slo["shed-rate"]
+        # slow window [390, 490]: submits 390->490 span obs t=390..490
+        # -> 10 sheds over 100 submits
+        assert p["slow"]["fraction"] == pytest.approx(0.1)
+
+
+class TestMultiWindowDiscipline:
+    def test_fast_spike_without_slow_confirm_does_not_trip(self):
+        """A blip: the fast window burns hot but the slow window stays
+        inside budget -> not burning (the anti-page-on-noise half)."""
+        ev = shed_ev()
+        ev.observe(snap({"serve/submitted": 0}), t=0.0)
+        # 90 s of clean traffic...
+        ev.observe(snap({"serve/submitted": 8800}), t=90.0)
+        ev.observe(snap({"serve/submitted": 9000}), t=95.0)
+        # ...then 60 sheds in the last 300 submits: fast window [90,100]
+        # burns at 0.2/0.1 = 2.0, the whole-run slow window barely moves
+        ev.observe(snap({"serve/submitted": 9100,
+                         "serve/shed/cause=deadline": 60}), t=100.0)
+        d = ev.decide(t=100.0)
+        p = d.per_slo["shed-rate"]
+        assert p["fast"]["burn"] >= 2.0         # hot fast window
+        assert p["slow"]["burn"] < 1.0          # cold slow window
+        assert not d.overloaded                 # AND discipline holds
+
+    def test_sustained_burn_trips_and_fast_release_recovers(self):
+        ev = shed_ev()
+        ev.observe(snap({"serve/submitted": 0}), t=0.0)
+        ev.observe(snap({"serve/submitted": 100,
+                         "serve/shed/cause=deadline": 50}), t=10.0)
+        d1 = ev.decide(t=10.0)
+        assert d1.new_trips == ["shed-rate"] and d1.overloaded
+        # next window: burn continues -> still burning, but NOT a new
+        # trip (trips are rising edges)
+        ev.observe(snap({"serve/submitted": 200,
+                         "serve/shed/cause=deadline": 100}), t=20.0)
+        d2 = ev.decide(t=20.0)
+        assert d2.overloaded and d2.new_trips == []
+        # clean traffic: the FAST window releases even though the slow
+        # window still remembers the burn
+        ev.observe(snap({"serve/submitted": 400,
+                         "serve/shed/cause=deadline": 100}), t=35.0)
+        d3 = ev.decide(t=35.0)
+        p = d3.per_slo["shed-rate"]
+        assert p["slow"]["burn"] >= 1.0
+        assert p["fast"]["burn"] < 2.0
+        assert not d3.overloaded and d3.recovered == ["shed-rate"]
+
+    def test_trips_listed_in_timeline_and_report(self):
+        ev = shed_ev()
+        ev.observe(snap({"serve/submitted": 0}), t=0.0)
+        ev.observe(snap({"serve/submitted": 100,
+                         "serve/shed/cause=deadline": 60}), t=10.0)
+        ev.decide(t=10.0)
+        assert len(ev.trips()) == 1
+        rep = ev.report()
+        assert rep["trips"]["shed-rate"] == 1
+        assert rep["peak_burns"]["shed-rate"]["fast"] >= 2.0
+        assert rep["decisions"] == len(rep["timeline"]) == 1
+
+
+class TestThresholdKind:
+    def test_worst_matching_histogram_field_drives_the_burn(self):
+        ev = SloEvaluator([p99_latency_slo(0.5)], fast_window_s=10,
+                          slow_window_s=100)
+        hists = {"serve/latency_s/tier=0": {"p99": 0.2},
+                 "serve/latency_s/tier=1": {"p99": 0.8}}
+        ev.observe(snap(histograms=hists), t=0.0)
+        ev.observe(snap(histograms=hists), t=5.0)
+        d = ev.decide(t=5.0)
+        p = d.per_slo["p99-latency"]
+        assert p["fast"]["value"] == pytest.approx(0.8)     # max tier
+        assert p["fast"]["burn"] == pytest.approx(1.6)
+
+    def test_missing_or_empty_histograms_read_as_no_burn(self):
+        ev = SloEvaluator([p99_latency_slo(0.5)], fast_window_s=10,
+                          slow_window_s=100)
+        ev.observe(snap(histograms={"serve/latency_s/tier=0":
+                                    {"p99": None}}), t=0.0)
+        d = ev.decide(t=0.0)
+        assert d.per_slo["p99-latency"]["fast"]["burn"] == 0.0
+        assert not d.overloaded
+
+
+class TestRegistryExport:
+    def test_burn_gauges_and_rising_edge_trip_counter(self):
+        reg = MetricRegistry()
+        ev = SloEvaluator([shed_rate_slo(0.1)], fast_window_s=10,
+                          slow_window_s=100, registry=reg)
+        ev.observe(snap({"serve/submitted": 0}), t=0.0)
+        ev.observe(snap({"serve/submitted": 100,
+                         "serve/shed/cause=deadline": 50}), t=10.0)
+        ev.decide(t=10.0)
+        assert reg.gauge("slo/fast_burn/slo=shed-rate").value == \
+            pytest.approx(5.0)
+        assert reg.counter("slo/trips/slo=shed-rate").value == 1
+        # still burning next window: the trip counter does NOT re-fire
+        ev.observe(snap({"serve/submitted": 200,
+                         "serve/shed/cause=deadline": 100}), t=20.0)
+        ev.decide(t=20.0)
+        assert reg.counter("slo/trips/slo=shed-rate").value == 1
+
+
+class TestScaleHint:
+    def test_hint_follows_burn_state(self):
+        ev = shed_ev()
+        ev.observe(snap({"serve/submitted": 0}), t=0.0)
+        ev.observe(snap({"serve/submitted": 100,
+                         "serve/shed/cause=deadline": 50}), t=10.0)
+        assert ev.decide(t=10.0).scale_hint == 1        # burning: grow
+        # fully clean on both windows: shrink
+        ev2 = shed_ev()
+        ev2.observe(snap({"serve/submitted": 0}), t=0.0)
+        ev2.observe(snap({"serve/submitted": 1000}), t=50.0)
+        assert ev2.decide(t=50.0).scale_hint == -1
+        # warm but under threshold: hold
+        ev3 = shed_ev()
+        ev3.observe(snap({"serve/submitted": 0}), t=0.0)
+        ev3.observe(snap({"serve/submitted": 1000,
+                          "serve/shed/cause=deadline": 80}), t=50.0)
+        d = ev3.decide(t=50.0)
+        assert not d.overloaded and d.scale_hint == 0
+
+
+class TestLadderViaSlo:
+    """The serving integration: a real ServingRuntime on a VirtualClock
+    whose DegradationLadder is driven by SloDecision instead of the raw
+    overload flag."""
+
+    def _runtime(self, clock, obs, slo, **kw):
+        from analytics_zoo_tpu.serving import ServingRuntime, ServingTier
+        from analytics_zoo_tpu.serving.ladder import LadderPolicy
+
+        def fwd(batch):
+            x = batch["input"]
+            return x.reshape(x.shape[0], -1).sum(axis=1)
+
+        return ServingRuntime(
+            [ServingTier("fp", fwd, speed=1.0),
+             ServingTier("int8", fwd, speed=0.5)],
+            n_replicas=1, clock=clock, queue_capacity=64, max_batch=2,
+            default_deadline_s=0.05, wedge_timeout_s=10.0,
+            service_time=lambda e, n, t: 0.08 * (0.5 if t else 1.0),
+            ladder_policy=LadderPolicy(down_after=2, up_after=3),
+            decision_every=2, obs=obs, slo=slo, **kw)
+
+    def _evaluator(self, obs):
+        return SloEvaluator([deadline_miss_slo(0.2)], fast_window_s=1.0,
+                            slow_window_s=10.0, registry=obs.registry)
+
+    def test_slo_burn_steps_the_ladder_down_and_recovery_steps_up(self):
+        clock = VirtualClock()
+        obs = Observability(capacity=4096)
+        rt = self._runtime(clock, obs, self._evaluator(obs))
+        # overload: 0.08 s service per 2-batch against a 0.05 s deadline
+        # at 3 submits per pump — nearly everything completes late
+        for i in range(30):
+            for _ in range(3):
+                try:
+                    rt.submit({"input": np.ones((1, 2), np.float32)})
+                except Exception:
+                    pass
+            rt.pump()
+            clock.advance(0.01)
+        clock.advance(1.0)
+        rt.drain()
+        downs = [e for e in rt.ladder.events if e["kind"] == "tier_down"]
+        assert downs, rt.ladder.events
+        assert downs[0]["slo_burning"] == ["deadline-miss-rate"]
+        assert rt.slo.trips(), "no fast-window trip recorded"
+        # decisions landed in the black box, one note per decision
+        notes = obs.recorder.events("slo_decision")
+        assert len(notes) == len(rt.slo.timeline) > 0
+        assert any(n["new_trips"] for n in notes)
+
+        # recovery: generous-deadline trickle, fast window clears, the
+        # ladder climbs back on clean SLO windows
+        for i in range(40):
+            rt.submit({"input": np.ones((1, 2), np.float32)},
+                      deadline_s=5.0)
+            rt.pump(force=True)
+            clock.advance(0.3)
+        rt.drain()
+        ups = [e for e in rt.ladder.events if e["kind"] == "tier_up"]
+        assert ups and rt.ladder.tier == 0
+        assert rt.slo.timeline[-1]["overloaded"] is False
+
+    def test_snapshot_carries_slo_report_only_when_armed(self):
+        clock = VirtualClock()
+        obs = Observability(capacity=256)
+        rt = self._runtime(clock, obs, self._evaluator(obs))
+        rt.submit({"input": np.ones((1, 2), np.float32)})
+        clock.advance(1.0)
+        rt.drain()
+        s = rt.snapshot()
+        assert "slo" in s and s["slo"]["slos"][0]["name"] == \
+            "deadline-miss-rate"
+
+        rt2 = self._runtime(VirtualClock(), Observability(capacity=256),
+                            None)
+        assert "slo" not in rt2.snapshot()
+
+    def test_unarmed_runtime_keeps_the_raw_decision_path(self):
+        """slo=None preserves pre-PR-11 behavior exactly: raw
+        shed/depth windows, no slo_decision events (the banked OBS_r01
+        / RESILIENCE_r03 replay contract)."""
+        clock = VirtualClock()
+        obs = Observability(capacity=1024)
+        rt = self._runtime(clock, obs, None)
+        for i in range(12):
+            try:
+                rt.submit({"input": np.ones((1, 2), np.float32)})
+            except Exception:
+                pass
+            rt.pump()
+            clock.advance(0.005)
+        clock.advance(1.0)
+        rt.drain()
+        assert obs.recorder.events("slo_decision") == []
+        downs = [e for e in rt.ladder.events if e["kind"] == "tier_down"]
+        for e in downs:
+            assert "slo_burning" not in e
+
+
+class TestBoundedTimeline:
+    def test_timeline_ring_evicts_but_aggregates_stay_correct(self):
+        """Review fix: the decision timeline is a counted ring; peaks,
+        trip counts, and the decision total survive eviction (the
+        ServingMetrics unbounded-list pathology must not return)."""
+        ev = SloEvaluator([shed_rate_slo(0.1)], fast_window_s=10,
+                          slow_window_s=100, timeline_cap=4)
+        ev.observe(snap({"serve/submitted": 0}), t=0.0)
+        ev.observe(snap({"serve/submitted": 100,
+                         "serve/shed/cause=deadline": 50}), t=10.0)
+        ev.decide(t=10.0)                   # the trip + the peak burn
+        for i in range(2, 12):
+            ev.observe(snap({"serve/submitted": 100 * i,
+                             "serve/shed/cause=deadline": 50}),
+                       t=10.0 * i)
+            ev.decide(t=10.0 * i)
+        assert len(ev.timeline) == 4
+        assert ev.timeline_evicted == 7
+        rep = ev.report()
+        assert rep["decisions"] == 11
+        assert rep["timeline_evicted"] == 7
+        # the trip and the 5x peak happened in since-evicted entries
+        assert rep["trips"]["shed-rate"] == 1
+        assert rep["peak_burns"]["shed-rate"]["fast"] == pytest.approx(5.0)
+
+    def test_timeline_cap_validated(self):
+        with pytest.raises(ValueError, match="timeline_cap"):
+            SloEvaluator([shed_rate_slo(0.1)], fast_window_s=1,
+                         slow_window_s=10, timeline_cap=0)

@@ -464,3 +464,114 @@ class TestBenchScalingDrill:
                  if ln.startswith('{"drill"')][-1]["drill"]
         assert drill["ok"] is True
         assert drill["fingerprint_match_bitexact"] is True
+
+
+class TestAzTrace:
+    """tools/az_trace.py: the SLO-driven drill smoke, the committed
+    OBS_r02.json, and the regression sentinel (self-diff clean, a
+    doctored baseline flagged)."""
+
+    def test_smoke_drill_all_checks_pass(self):
+        from tools.az_trace import az_trace_drill
+
+        result = az_trace_drill(seed=0, smoke=True)
+        assert result["checks"]["ok"], result["checks"]
+        # the load-bearing pieces individually, for a readable failure
+        assert result["checks"]["critical_path_conservation_ok"]
+        assert result["checks"]["fast_window_trip_happened"]
+        assert result["checks"]["trip_drove_ladder_step_down"]
+        assert result["checks"]["replay_byte_identical_from_seed"]
+        assert result["tail_attribution"]["dominant_segment"]
+
+    def test_committed_obs_r02_passes_its_own_checks_and_is_stamped(self):
+        import json
+
+        from tools.check_artifacts import LEGACY, PATTERN, REQUIRED_KEYS
+
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "OBS_r02.json")
+        report = json.load(open(path))
+        assert report["verdict"] == "PASS" and report["checks"]["ok"]
+        assert report["serve_trace"]["replay_identical"] is True
+        assert report["checks"]["analysis_replay_identical"] is True
+        assert report["slo"]["decisions"] > 0
+        assert sum(report["slo"]["trips"].values()) >= 1
+        downs = [e for e in report["ladder"]["transitions"]
+                 if e["kind"] == "tier_down"]
+        assert downs and downs[0]["slo_burning"]
+        assert report["critical_path_conservation"]["violations"] == []
+        # covered by the artifact lint as STAMPED, not grandfathered
+        assert PATTERN.match("OBS_r02.json")
+        assert "OBS_r02.json" not in LEGACY
+        meta = report["run_metadata"]
+        assert all(k in meta for k in REQUIRED_KEYS)
+
+    def test_sentinel_self_diff_is_clean(self, tmp_path):
+        """baseline vs itself: the seeded drill is deterministic, so a
+        fresh run diffed against a just-banked smoke baseline must be
+        CLEAN (exit 0) — the sentinel only fires when code changes the
+        tail."""
+        import json
+
+        import tools.az_trace as az
+
+        result = az.az_trace_drill(seed=0, smoke=True)
+        baseline = {"drill": "az_trace", "seed": 0, "smoke": True,
+                    **result}
+        path = tmp_path / "baseline.json"
+        path.write_text(json.dumps(baseline))
+        code, regressions = az.run_sentinel(str(path))
+        assert code == 0 and regressions == [], regressions
+
+    def test_sentinel_flags_a_doctored_baseline(self):
+        """Shrink the baseline's tail numbers: the (unchanged) fresh
+        report now reads as a regression on exactly the doctored
+        axes."""
+        import copy
+        import json
+
+        from tools.az_trace import az_trace_drill, sentinel_diff
+
+        fresh = az_trace_drill(seed=0, smoke=True)
+        baseline = copy.deepcopy(json.loads(json.dumps(fresh)))
+        baseline["tail_attribution"]["percentiles"]["p99_s"] /= 2.0
+        seg = baseline["tail_attribution"]["segments"]["queue_wait"]
+        seg["p99_mean_s"] /= 2.0
+        baseline["slo"]["peak_burns"]["shed-rate"]["fast"] /= 2.0
+        regressions = sentinel_diff(baseline, fresh)
+        text = "\n".join(regressions)
+        assert "p99 latency" in text
+        assert "segment queue_wait" in text
+        assert "peak fast burn [shed-rate]" in text
+        # and the un-doctored twin stays clean
+        assert sentinel_diff(fresh, fresh) == []
+
+    def test_cli_drill_and_query_modes(self, tmp_path):
+        """End-to-end CLI: --drill writes a stamped artifact +
+        flight JSONL; the query modes run over that recording."""
+        import json
+
+        import tools.az_trace as az
+
+        out = tmp_path / "OBS_smoke.json"
+        flight = tmp_path / "flight.jsonl"
+        rc = az.main(["--drill", "--smoke", "--out", str(out),
+                      "--flight-out", str(flight)])
+        assert rc == 0
+        report = json.loads(out.read_text())
+        assert report["verdict"] == "PASS"
+        assert "run_metadata" in report
+        assert flight.exists()
+        # query modes over the dumped recording
+        assert az.main(["--flight", str(flight), "--attribute",
+                        "--slo-report"]) == 0
+        done_trace = None
+        for line in flight.read_text().splitlines():
+            e = json.loads(line)
+            if e.get("kind") == "span" and e.get("parent") is None \
+                    and e.get("status") == "done":
+                done_trace = e["trace"]
+                break
+        assert done_trace is not None
+        assert az.main(["--flight", str(flight), "--critical-path",
+                        done_trace]) == 0

@@ -1,0 +1,324 @@
+"""TraceStore (obs.trace): queries, critical-path math, conservation,
+tail attribution, and the JSONL ingest/export inverse.
+
+Two layers: synthetic recordings with hand-placed boundaries pin the
+segment arithmetic EXACTLY (no drill noise between the test and the
+math), and one SLO-driven smoke drill pins the same invariants over a
+real runtime's recording (the OBS_r02 shape at CI size).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from analytics_zoo_tpu.obs import FlightRecorder, TraceStore
+from analytics_zoo_tpu.obs.trace import (SEGMENTS, attribution_rows,
+                                         format_critical_path)
+
+
+def _span(name, trace, span, parent, t0, t1, status, attrs=None):
+    ev = {"kind": "span", "name": name, "trace": trace, "span": span,
+          "parent": parent, "t0": t0, "t1": t1,
+          "dur": round(t1 - t0, 6) if t1 is not None else None,
+          "status": status}
+    if attrs:
+        ev["attrs"] = dict(sorted(attrs.items()))
+    return ev
+
+
+def _request_events(rid, t_submit, t_assembled, t_done, status="done",
+                    batch=1, tier=0, span0=0):
+    """One dispatched request's three spans, runtime-shaped."""
+    trace = f"req-{rid}"
+    return [
+        _span("request", trace, span0, None, t_submit, t_done, status,
+              {"rid": rid}),
+        _span("queue", trace, span0 + 1, span0, t_submit, t_assembled,
+              "assembled"),
+        _span("dispatch", trace, span0 + 2, span0, t_assembled, t_done,
+              status, {"tier": tier, "batch": batch}),
+    ]
+
+
+def _store(events):
+    # stamp seq the way the recorder does, so to_jsonl is dump-shaped
+    rec = FlightRecorder(capacity=len(events) + 8, clock=lambda: 0.0)
+    for e in events:
+        rec.record(e)
+    return TraceStore.from_recorder(rec)
+
+
+class TestQueries:
+    def _populated(self):
+        events = (_request_events(0, 0.0, 0.3, 1.0)
+                  + _request_events(1, 0.1, 0.5, 2.0, batch=2, span0=3)
+                  + [_span("request", "req-2", 6, None, 0.2, 0.6,
+                           "timeout", {"rid": 2}),
+                     _span("queue", "req-2", 7, 6, 0.2, 0.6, "deadline"),
+                     _span("batch", "batch-1", 8, None, 0.3, 1.0, "done"),
+                     {"kind": "replica_fenced", "replica": 0, "t": 1.2}])
+        return _store(events)
+
+    def test_trace_ids_and_prefix_filter(self):
+        s = self._populated()
+        assert s.trace_ids() == ["req-0", "req-1", "req-2", "batch-1"]
+        assert s.trace_ids("req-") == ["req-0", "req-1", "req-2"]
+        assert s.trace_ids("batch-") == ["batch-1"]
+
+    def test_trace_and_root(self):
+        s = self._populated()
+        spans = s.trace("req-0")
+        assert [x["name"] for x in spans] == ["request", "queue",
+                                              "dispatch"]
+        assert s.root("req-0")["name"] == "request"
+        assert s.root("missing") is None
+
+    def test_span_filters_name_status_window(self):
+        s = self._populated()
+        assert len(s.spans(name="queue")) == 3
+        assert {x["trace"] for x in s.spans(status="timeout")} == \
+            {"req-2"}
+        # time window intersects: req-1's dispatch [0.5, 2.0] overlaps
+        # [1.5, 3.0]; req-0's dispatch [0.3, 1.0] does not
+        hits = s.spans(name="dispatch", t0=1.5, t1=3.0)
+        assert [x["trace"] for x in hits] == ["req-1"]
+
+    def test_requests_by_root_status(self):
+        s = self._populated()
+        assert s.requests("done") == ["req-0", "req-1"]
+        assert s.requests("timeout") == ["req-2"]
+        assert len(s.requests()) == 3
+
+    def test_events_of_kind_and_summary(self):
+        s = self._populated()
+        assert len(s.events_of("replica_fenced")) == 1
+        sm = s.summary()
+        assert sm["requests"] == 3 and sm["traces"] == 4
+        assert sm["events_by_kind"]["span"] == sm["spans"]
+
+
+class TestJsonlInverse:
+    def test_ingest_export_are_inverses_of_the_recorder_dump(self):
+        rec = FlightRecorder(capacity=64, clock=lambda: 0.0)
+        for e in _request_events(0, 0.0, 0.25, 0.75):
+            rec.record(e)
+        rec.note("slo_decision", overloaded=False, burning=[])
+        text = rec.to_jsonl()
+        store = TraceStore.from_jsonl(text)
+        assert store.to_jsonl() == text
+        # and a second generation round-trips too (fixed point)
+        assert TraceStore.from_jsonl(store.to_jsonl()).to_jsonl() == text
+
+    def test_from_file(self, tmp_path):
+        rec = FlightRecorder(capacity=8, clock=lambda: 0.0)
+        rec.note("ping", x=1)
+        p = tmp_path / "flight.jsonl"
+        p.write_text(rec.to_jsonl())
+        store = TraceStore.from_file(str(p))
+        assert store.to_jsonl() == rec.to_jsonl()
+
+
+class TestCriticalPath:
+    def test_plain_request_segments_tile_the_root(self):
+        s = _store(_request_events(0, 0.0, 0.3, 1.0))
+        cp = s.critical_path("req-0")
+        assert cp["status"] == "done"
+        assert cp["latency_s"] == pytest.approx(1.0)
+        assert cp["segments"]["queue_wait"] == pytest.approx(0.3)
+        assert cp["segments"]["batch_assembly"] == pytest.approx(0.0)
+        assert cp["segments"]["dispatch"] == pytest.approx(0.7)
+        assert cp["segments"]["failover_redispatch"] == 0.0
+        assert abs(cp["residual_s"]) < 1e-12
+        assert cp["batch"] == "batch-1" and cp["tier"] == 0
+
+    def test_failover_splits_the_dispatch_segment(self):
+        events = _request_events(7, 0.0, 0.5, 2.0)
+        events.append({"kind": "failover", "from": 0, "to": 1, "t": 1.5,
+                       "requests": [7]})
+        cp = _store(events).critical_path("req-7")
+        assert cp["segments"]["failover_redispatch"] == pytest.approx(1.0)
+        assert cp["segments"]["dispatch"] == pytest.approx(0.5)
+        assert abs(cp["residual_s"]) < 1e-12
+
+    def test_failover_outside_dispatch_window_is_not_attributed(self):
+        events = _request_events(7, 0.0, 0.5, 2.0)
+        # a different batch's failover listing another rid, and one for
+        # this rid but before its dispatch started
+        events.append({"kind": "failover", "from": 0, "to": 1, "t": 1.5,
+                       "requests": [9]})
+        events.append({"kind": "failover", "from": 0, "to": 1, "t": 0.2,
+                       "requests": [7]})
+        cp = _store(events).critical_path("req-7")
+        assert cp["segments"]["failover_redispatch"] == 0.0
+
+    def test_undispatched_request_is_all_queue_wait(self):
+        events = [_span("request", "req-3", 0, None, 0.0, 0.4, "timeout",
+                        {"rid": 3}),
+                  _span("queue", "req-3", 1, 0, 0.0, 0.4, "deadline")]
+        cp = _store(events).critical_path("req-3")
+        assert cp["segments"]["queue_wait"] == pytest.approx(0.4)
+        assert sum(cp["segments"].values()) == pytest.approx(0.4)
+        assert cp["batch"] is None and cp["tier"] is None
+
+    def test_missing_trace_and_unended_root_raise(self):
+        s = _store(_request_events(0, 0.0, 0.3, 1.0))
+        with pytest.raises(KeyError):
+            s.critical_path("req-404")
+        bad = _store([_span("request", "req-9", 0, None, 0.0, None,
+                            None, {"rid": 9})])
+        with pytest.raises(ValueError):
+            bad.critical_path("req-9")
+
+    def test_conservation_passes_clean_and_flags_a_doctored_trace(self):
+        s = _store(_request_events(0, 0.0, 0.3, 1.0)
+                   + _request_events(1, 0.0, 0.2, 0.9, span0=3))
+        ok = s.critical_path_conservation()
+        assert ok["ok"] and ok["checked"] == 2
+
+        # doctor: root claims 0.2 s more than its children account for
+        events = _request_events(5, 0.0, 0.3, 1.0)
+        events[0]["t1"] = 1.2
+        bad = _store(events)
+        res = bad.critical_path_conservation()
+        assert not res["ok"]
+        assert "req-5" in res["violations"][0]
+
+    def test_format_critical_path_renders(self):
+        s = _store(_request_events(0, 0.0, 0.3, 1.0))
+        text = format_critical_path(s.critical_path("req-0"))
+        assert "req-0" in text and "queue_wait" in text
+
+
+class TestTailAttribution:
+    def _cohort_store(self):
+        """100 fast requests (queue 0.02 / dispatch 0.08) and five slow
+        whales whose extra latency is ENTIRELY queue wait (the p99
+        nearest-rank cut over 105 samples lands on the whales)."""
+        events = []
+        for i in range(100):
+            events += _request_events(i, 0.0, 0.02, 0.1, span0=3 * i)
+        for j in range(5):
+            events += _request_events(100 + j, 0.0, 0.92, 1.0,
+                                      span0=300 + 3 * j)
+        return _store(events)
+
+    def test_p99_cohort_vs_p50_cohort_attributes_the_grown_segment(self):
+        rep = self._cohort_store().tail_attribution()
+        assert rep["n_done"] == 105
+        assert rep["dominant_segment"] == "queue_wait"
+        seg = rep["segments"]["queue_wait"]
+        assert seg["p50_mean_s"] == pytest.approx(0.02)
+        assert seg["p99_mean_s"] == pytest.approx(0.92)
+        # dispatch did NOT grow; the whole cohort gap is queue wait
+        assert rep["segments"]["dispatch"]["delta_s"] == pytest.approx(0.0)
+        assert seg["share_of_gap"] == pytest.approx(1.0, abs=1e-3)
+        assert rep["percentiles"]["p99_s"] == pytest.approx(1.0)
+        assert rep["cohorts"]["p99"]["n"] == 5
+        assert rep["cohorts"]["p50"]["n"] == 100
+
+    def test_statuses_counted_alongside(self):
+        events = (_request_events(0, 0.0, 0.02, 0.1)
+                  + [_span("request", "req-1", 3, None, 0.0, 0.4,
+                           "timeout", {"rid": 1})])
+        rep = _store(events).tail_attribution()
+        assert rep["by_status"] == {"done": 1, "timeout": 1}
+
+    def test_empty_store_reports_nothing_to_attribute(self):
+        rep = _store([]).tail_attribution()
+        assert rep["n_done"] == 0 and "note" in rep
+
+    def test_attribution_rows_render_every_segment(self):
+        rep = self._cohort_store().tail_attribution()
+        rows = attribution_rows(rep)
+        assert [name for name, _ in rows] == list(SEGMENTS)
+        assert all("delta" in r for _, r in rows)
+
+
+class TestDrillIntegration:
+    """One SLO-driven smoke drill (the OBS_r02 scenario at CI size):
+    the real runtime's recording satisfies every structural invariant
+    the committed artifact pins."""
+
+    @pytest.fixture(scope="class")
+    def drill(self):
+        from tools.az_trace import run_slo_drill
+
+        rt, obs, text, analysis = run_slo_drill(seed=0, smoke=True)
+        return rt, obs, text, analysis
+
+    def test_critical_path_conservation_over_every_done_request(
+            self, drill):
+        _, _, _, analysis = drill
+        cpc = analysis["critical_path_conservation"]
+        assert cpc["ok"], cpc["violations"][:5]
+        assert cpc["checked"] > 100
+
+    def test_store_round_trips_the_drill_recording(self, drill):
+        _, _, text, _ = drill
+        assert TraceStore.from_jsonl(text).to_jsonl() == text
+
+    def test_attribution_names_a_dominant_segment(self, drill):
+        _, _, _, analysis = drill
+        attr = analysis["tail_attribution"]
+        assert attr["dominant_segment"] in SEGMENTS
+        assert attr["percentiles"]["p99_s"] >= attr["percentiles"]["p50_s"]
+        assert attr["cohort_gap_s"] > 0
+
+    def test_slo_decisions_recorded_in_the_black_box(self, drill):
+        rt, _, text, analysis = drill
+        store = TraceStore.from_jsonl(text)
+        notes = store.events_of("slo_decision")
+        assert len(notes) == analysis["slo"]["decisions"] > 0
+        # the ladder transition detail names the burning SLOs
+        downs = [e for e in analysis["ladder"]["transitions"]
+                 if e["kind"] == "tier_down"]
+        assert downs and all("slo_burning" in e for e in downs)
+
+    def test_failover_tail_is_attributed_to_the_failover_segment(
+            self, drill):
+        """The drill injects a crash + a 5 s wedge; the requests that
+        rode those batches exist and carry a failover segment."""
+        _, _, text, _ = drill
+        store = TraceStore.from_jsonl(text)
+        fo = [store.critical_path(t) for t in store.requests("done")]
+        hit = [p for p in fo
+               if p["segments"]["failover_redispatch"] > 0]
+        assert hit, "no request carries failover time despite the fault"
+
+
+class TestReviewFixes:
+    def test_open_spans_match_lower_bounded_window_queries(self):
+        """Review fix: a still-open span (t1 null — a mid-run black-box
+        dump) extends to the end of the recording; a t0-bounded query
+        must return it, not hide the one span that never ended."""
+        events = [_span("request", "req-0", 0, None, 0.0, None, None,
+                        {"rid": 0})]
+        events[0]["dur"] = None
+        wedged = dict(events[0])
+        store = _store([wedged,
+                        _span("dispatch", "req-0", 1, 0, 3.0, None,
+                              None)])
+        hits = store.spans(name="dispatch", t0=5.0)
+        assert len(hits) == 1 and hits[0]["t1"] is None
+        # but an upper bound BEFORE the span started still excludes it
+        assert store.spans(name="dispatch", t1=2.0) == []
+
+    def test_attribution_rows_order_percentiles_numerically(self):
+        """Review fix: p5/p50 must not swap columns (lexicographic sort
+        puts 'p50' before 'p5')."""
+        events = []
+        for i in range(100):
+            events += _request_events(i, 0.0, 0.02, 0.1, span0=3 * i)
+        for j in range(5):
+            events += _request_events(100 + j, 0.0, 0.92, 1.0,
+                                      span0=300 + 3 * j)
+        rep = _store(events).tail_attribution(p_lo=5.0, p_hi=50.0)
+        rows = dict(attribution_rows(rep))
+        # low percentile rendered first: 0.020s -> (higher) mean
+        assert "0.020ms" not in rows["queue_wait"]  # sanity: ms scale
+        lo, hi = rows["queue_wait"].split("->")
+        assert "20.000ms" in lo

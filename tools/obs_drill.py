@@ -39,9 +39,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 REVISION = "r01"
 
 
-def traced_scenario(seed: int, smoke: bool, dump_path=None):
+def traced_scenario(seed: int, smoke: bool, dump_path=None,
+                    make_slo=None):
     """One drill-shaped scenario (burst + crash + wedge + ladder) with
-    the obs spine armed; returns ``(runtime, obs, script_len)``."""
+    the obs spine armed; returns ``(runtime, obs, script_len)``.
+    ``make_slo(obs)`` (optional) builds a fresh
+    ``analytics_zoo_tpu.obs.slo.SloEvaluator`` per run (the evaluator
+    is stateful, and the replay-identity check re-runs the scenario) —
+    the ladder then steps on SLO burn instead of the raw overload flag
+    (``tools/az_trace.py`` banks that variant as ``OBS_r02.json``)."""
     from analytics_zoo_tpu.obs import Observability
     from analytics_zoo_tpu.resilience.chaos import ChaosMonkey, FaultSpec
     from analytics_zoo_tpu.serving.ladder import LadderPolicy
@@ -72,7 +78,8 @@ def traced_scenario(seed: int, smoke: bool, dump_path=None):
                       queue_capacity=64,
                       ladder_policy=LadderPolicy(down_after=2, up_after=6,
                                                  depth_high=2),
-                      obs=obs)
+                      obs=obs,
+                      slo=make_slo(obs) if make_slo is not None else None)
     return rt, obs, len(script)
 
 

@@ -81,12 +81,15 @@ def build_arrival_script(rng: random.Random, smoke: bool, monkey) -> list:
 
 
 def run_scenario(script, tiers, tier_speeds, *, shed, chaos=None,
-                 queue_capacity, ladder_policy=None, obs=None):
+                 queue_capacity, ladder_policy=None, obs=None, slo=None):
     """Replay one arrival script against a fresh runtime; returns the
     runtime (drained: every request terminal).  ``obs`` (an
     ``analytics_zoo_tpu.obs.Observability``) arms the telemetry spine —
     request-lifecycle spans land in its flight recorder on the SAME
-    virtual clock, which is what ``tools/obs_drill.py`` banks."""
+    virtual clock, which is what ``tools/obs_drill.py`` banks.  ``slo``
+    (an ``analytics_zoo_tpu.obs.slo.SloEvaluator``) switches the
+    degradation ladder onto SLO burn-rate decisions — what
+    ``tools/az_trace.py`` banks as ``OBS_r02.json``."""
     import numpy as np
 
     from analytics_zoo_tpu.serving import ServingRuntime, VirtualClock
@@ -103,7 +106,7 @@ def run_scenario(script, tiers, tier_speeds, *, shed, chaos=None,
         default_deadline_s=0.3, wedge_timeout_s=1.5, restart_s=2.0,
         service_time=service_time, ladder_policy=ladder_policy,
         decision_every=DECISION_EVERY, shed_expired=shed, chaos=chaos,
-        obs=obs)
+        obs=obs, slo=slo)
 
     from analytics_zoo_tpu.resilience.errors import ServerOverloaded
 
