@@ -312,6 +312,7 @@ def _tier_targets(kind: str, tiers, specs) -> List[AuditProgram]:
 def _ssd_serving(mesh) -> List[AuditProgram]:
     from analytics_zoo_tpu.core.module import Model
     from analytics_zoo_tpu.models import SSDVgg
+    from analytics_zoo_tpu.ops import DetectionOutputParam
     from analytics_zoo_tpu.parallel import pipeline_specs
     from analytics_zoo_tpu.pipelines.ssd import (PreProcessParam,
                                                  ssd_serving_tiers)
@@ -324,11 +325,19 @@ def _ssd_serving(mesh) -> List[AuditProgram]:
     model.variables = filled(abstract_variables(
         module, _S((1, RES, RES, 3), np.float32)))
     specs = pipeline_specs("ssd", mesh=mesh)
-    tiers = ssd_serving_tiers(
-        model, PreProcessParam(batch_size=specs.data_axis_size,
-                               resolution=RES),
-        n_classes=NCLS, specs=specs)
-    return _tier_targets("ssd", tiers, specs)
+    param = PreProcessParam(batch_size=specs.data_axis_size,
+                            resolution=RES)
+    tiers = ssd_serving_tiers(model, param, n_classes=NCLS, specs=specs)
+    # the FUSED post-processing programs ("auto" resolves to them on a
+    # TPU backend, but this audit traces on CPU where auto is xla):
+    # audit the single-kernel DetectionOutput path explicitly so the
+    # exact programs the TPU serving tiers dispatch are covered like
+    # every other rung
+    fused = ssd_serving_tiers(
+        model, param, n_classes=NCLS, specs=specs,
+        post=DetectionOutputParam(n_classes=NCLS, backend="fused"))
+    return (_tier_targets("ssd", tiers, specs)
+            + _tier_targets("ssd-fused", fused, specs))
 
 
 def _ds2_serving(mesh) -> List[AuditProgram]:

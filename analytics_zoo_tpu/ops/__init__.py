@@ -25,6 +25,10 @@ from analytics_zoo_tpu.ops.multibox_loss import (
     multibox_loss,
 )
 from analytics_zoo_tpu.ops.frcnn import FrcnnPostParam, frcnn_postprocess
+from analytics_zoo_tpu.ops.pallas_detout import (
+    fused_detection_output,
+    fused_vmem_bytes,
+)
 from analytics_zoo_tpu.ops.pallas_rnn import (
     persistent_rnn,
     persistent_vmem_bytes,

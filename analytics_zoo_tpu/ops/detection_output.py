@@ -30,15 +30,26 @@ from analytics_zoo_tpu.ops.nms import nms
 class DetectionOutputParam:
     """Reference ``PostProcessParam`` (``ssd/model/SSDGraph.scala:36``).
 
-    ``backend`` selects the per-class NMS implementation: ``"xla"`` (IoU
-    matrix + fori_loop, ``ops/nms.py``), ``"pallas"`` (VMEM-resident
-    suppression sweep, ``ops/pallas_nms.py`` — runs the real kernel on TPU
-    and falls back to interpret mode elsewhere), or ``"auto"`` (default:
-    pallas on a TPU backend — measured ~3.6× faster than the XLA loop on
-    v5e with identical outputs — XLA otherwise, since interpret-mode
-    pallas is slow on CPU).  Both implement the same reference semantics
-    (topk-400 pre-filter, greedy IoU suppression, global keep-topk), so
-    outputs agree up to score ties.
+    ``backend`` selects the implementation:
+
+    - ``"xla"``: per-class IoU matrix + fori_loop NMS (``ops/nms.py``);
+    - ``"pallas"``: candidate selection in XLA, the suppression sweep as
+      the VMEM-resident ``ops/pallas_nms.py`` kernel — four stages with
+      (B, C, K) intermediates between them;
+    - ``"fused"``: the whole chain (decode → filter+selection →
+      suppression → global top-K) as ONE batched Pallas program over a
+      (batch, class) grid (``ops/pallas_detout.py``) — candidates never
+      leave VMEM between stages.  Geometries over the kernel's VMEM
+      budget warn and fall back to ``"pallas"``;
+    - ``"auto"`` (default): fused on a TPU backend (pallas instead when
+      ``approx_topk`` is requested — the approx selection only exists on
+      the unfused path), XLA otherwise (interpret-mode pallas is slow on
+      CPU).
+
+    All backends implement the same reference semantics (topk-400
+    pre-filter, greedy IoU suppression, global keep-topk), so outputs
+    agree up to float associativity (score-tie ORDER also agrees:
+    every backend tie-breaks lowest-index-first).
     """
 
     n_classes: int = 21
@@ -183,12 +194,37 @@ def detection_output(loc: jax.Array, conf: jax.Array, priors: jax.Array,
                      ) -> jax.Array:
     """Batched: loc (B,P,4), conf (B,P,C) → (B, keep_topk, 6).
 
-    Dispatches on ``param.backend``; the pallas path compiles the real TPU
-    kernel when a TPU backend is active and interprets elsewhere (CI)."""
+    Dispatches on ``param.backend``; the pallas/fused paths compile real
+    TPU kernels when a TPU backend is active and interpret elsewhere
+    (CI).  The fused path checks its VMEM planning estimate
+    (``ops.pallas_detout.fused_vmem_bytes``) against the budget and
+    warns-and-falls-back to the unfused pallas path when a geometry
+    cannot be VMEM-resident — never an error."""
     on_tpu = jax.default_backend() in ("tpu", "axon")
     backend = param.backend
     if backend == "auto":
-        backend = "pallas" if on_tpu else "xla"
+        if on_tpu:
+            backend = "pallas" if param.approx_topk else "fused"
+        else:
+            backend = "xla"
+    if backend == "fused":
+        from analytics_zoo_tpu.ops import pallas_detout
+
+        _, _, C = conf.shape
+        P = priors.shape[0]
+        need = pallas_detout.fused_vmem_bytes(P, C, param.keep_topk)
+        if need > pallas_detout.VMEM_BUDGET_BYTES:
+            import warnings
+            warnings.warn(
+                f"fused DetectionOutput needs ~{need / 2**20:.1f} MiB VMEM "
+                f"(P={P}, C={C}, keep_topk={param.keep_topk}) over the "
+                f"{pallas_detout.VMEM_BUDGET_BYTES / 2**20:.0f} MiB budget"
+                " — falling back to the unfused pallas path")
+            backend = "pallas"
+        else:
+            return pallas_detout.fused_detection_output(
+                loc, conf, priors, variances, param=param,
+                interpret=not on_tpu)
     if backend == "pallas":
         return _detection_output_pallas(loc, conf, priors, variances,
                                         param=param, interpret=not on_tpu)

@@ -389,9 +389,22 @@ class SSDPredictor:
                                              compute_dtype=compute_dtype)
 
     def set_top_k(self, k: int) -> "SSDPredictor":
-        """Mutate keep_topk (reference ``setTopK`` mutating DetectionOutput)."""
-        self.post = dataclasses.replace(self.post, keep_topk=k)
-        return self
+        """Return a predictor serving ``keep_topk=k`` (reference
+        ``setTopK``, which mutates the DetectionOutput layer in place).
+
+        Copy-on-write on purpose: the RECEIVER is unchanged.  Serving
+        tiers close over a shared predictor and read ``self.post`` at
+        dispatch time, so the old in-place mutation silently changed
+        every tier's output geometry and forced a recompile of each
+        tier's serving program (``post`` is a static jit argument).
+        The returned copy shares weights and the cached jitted
+        programs — ``post`` is an argument, so no recompile of the
+        receiver's geometry ever happens."""
+        import copy
+
+        new = copy.copy(self)
+        new.post = dataclasses.replace(self.post, keep_topk=k)
+        return new
 
     def _serving_jit(self, fn, static_argnums, n_batch_args: int):
         """jit a serving program through the spec layer: with a declared
@@ -828,6 +841,16 @@ def ssd_serving_tiers(model: Model, param: PreProcessParam,
       mAP delta +0.0001 — INT8_MAP_PARITY.json);
     - tier 2 ``int8_topk``: int8 plus ``keep_topk=degraded_topk`` — a
       bounded, explicit post-processing cut (reference ``setTopK``).
+
+    All three rungs dispatch whatever DetectionOutput backend ``post``
+    selects — with the default ``backend="auto"`` that is the FUSED
+    single-kernel post-processing program on a TPU backend
+    (``ops/pallas_detout.py``; pass ``post=DetectionOutputParam(
+    backend="fused")`` to force it elsewhere, interpret-mode off-TPU),
+    so the int8 rung's conv win is no longer buried under four staged
+    post-processing dispatches (docs/PERFORMANCE.md "DetectionOutput").
+    The ``device_program`` thunks below expose exactly those fused
+    programs to the az-analyze serving audit.
 
     Requests carry preprocessed fixed-resolution images
     (``{"input": (H, W, 3) float32}``, no variable axis — the serving

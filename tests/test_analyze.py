@@ -544,6 +544,11 @@ class TestRepoClean:
             assert f"{pipe}/train" in names, names
             assert f"{pipe}/eval" in names, names
         assert {"ssd/serve:fp", "ssd/serve:int8"} <= names
+        # ISSUE 12: the FUSED DetectionOutput serving programs (what
+        # "auto" dispatches on TPU) are audited like every other rung
+        assert {"ssd-fused/serve:fp", "ssd-fused/serve:int8"} <= names
+        assert any(n.startswith("ssd-fused/serve:int8_topk")
+                   for n in names)
         assert any(n.startswith("ds2/serve:beam") for n in names)
         assert "ds2/serve:greedy" in names
 
@@ -559,6 +564,22 @@ class TestRepoClean:
         for target in _ssd_serving(mesh) + _ds2_serving(mesh):
             built = target.build()      # raises if the hook is missing
             assert callable(built.fn)
+
+    def test_fused_tier_without_device_program_is_a_finding(self):
+        """ISSUE 12 coverage pin: a backend="fused" serving tier that
+        stops exposing its ``device_program`` thunk must FAIL the audit
+        (the fused program would otherwise silently leave the audit
+        surface)."""
+        from analytics_zoo_tpu.analysis.targets import _tier_targets
+        from analytics_zoo_tpu.serving.ladder import ServingTier
+
+        tier = ServingTier("fp", forward=lambda b: b,
+                           device_program=None)
+        targets = _tier_targets("ssd-fused", [tier], specs=None)
+        assert [t.name for t in targets] == ["ssd-fused/serve:fp"]
+        got = audit_program(targets[0])
+        assert [v.rule for v in got] == ["program-trace-error"]
+        assert "device_program" in got[0].message
 
     def test_cli_exits_nonzero_with_file_line_diagnostics(self, tmp_path,
                                                           capsys):

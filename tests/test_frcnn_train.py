@@ -140,16 +140,23 @@ class TestFrcnnTrainStep:
         model.build(0, jnp.zeros((1, RES, RES, 3), jnp.float32),
                     jnp.asarray([[RES, RES, 1.0]], jnp.float32))
 
+        # jitted forward+loss: the eager FRCNN apply (proposal NMS
+        # fori_loops op-by-op on CPU) dominated this test's wall time;
+        # one compile serves all four evaluations (same batch shapes)
+        @jax.jit
+        def _loss(variables, x, info, gt_px, gt_mask, target, im_info):
+            out = model.module.apply(
+                variables, x, info, extra_rois=gt_px,
+                extra_rois_mask=gt_mask, train_outputs=True)
+            return frcnn_training_loss(out, {"target": target,
+                                             "im_info": im_info})
+
         def eval_loss(m):
             tot = 0.0
             for fb in frcnn_train_batches(iter(batches), RES):
                 x, info, gt_px, gt_mask = fb["input"]
-                out = m.module.apply(
-                    m.variables, jnp.asarray(x), jnp.asarray(info),
-                    extra_rois=jnp.asarray(gt_px),
-                    extra_rois_mask=jnp.asarray(gt_mask),
-                    train_outputs=True)
-                tot += float(frcnn_training_loss(out, fb))
+                tot += float(_loss(m.variables, x, info, gt_px, gt_mask,
+                                   fb["target"], fb["im_info"]))
             return tot / len(batches)
 
         from analytics_zoo_tpu.parallel import create_mesh

@@ -442,6 +442,46 @@ def test_uint8_serving_chain_matches_float_chain(tmp_path):
     np.testing.assert_allclose(d_u8, d_f32, atol=1e-4)
 
 
+def test_set_top_k_returns_new_predictor_tiers_unaffected():
+    """ISSUE 12 satellite: ``set_top_k`` must NOT mutate the shared
+    predictor — a serving tier built from it reads ``pred.post`` at
+    dispatch time, so the old in-place mutation silently changed every
+    tier's output geometry (and forced recompiles of the tier
+    programs).  Copy-on-write: receiver untouched, tier programs keep
+    their declared keep_topk."""
+    from analytics_zoo_tpu.pipelines.ssd import ssd_serving_tiers
+
+    param = PreProcessParam(batch_size=2, resolution=300)
+    model = Model(SSDVgg(num_classes=4, resolution=300))
+    model.build(0, jnp.zeros((1, 300, 300, 3)))
+
+    pred = SSDPredictor(model, param, n_classes=4)
+    before = pred.post
+    low = pred.set_top_k(7)
+    assert low is not pred
+    assert pred.post is before and pred.post.keep_topk == 200
+    assert low.post.keep_topk == 7
+
+    # tier programs built from the same model: their audit-hook example
+    # args carry each rung's OWN post param, and a later set_top_k on
+    # any predictor cannot reach into them
+    tiers = ssd_serving_tiers(model, param, n_classes=4, degraded_topk=50)
+    posts_before = [t.device_program()[1][-1] for t in tiers]
+    assert [p.keep_topk for p in posts_before] == [200, 200, 50]
+    low2 = pred.set_top_k(3)
+    posts_after = [t.device_program()[1][-1] for t in tiers]
+    assert [p.keep_topk for p in posts_after] == [200, 200, 50]
+    assert low2.post.keep_topk == 3
+
+    # and the dispatched geometry agrees: the shrunk COPY serves 7 rows
+    # (one compile; the receiver's 200-row program is pinned via the
+    # audit-hook args above without paying a second full-program
+    # compile in tier-1)
+    img = np.zeros((1, 300, 300, 3), np.float32)
+    assert np.asarray(low.detect_normalized(img)).shape == (1, 7, 6)
+    assert pred.post.keep_topk == 200
+
+
 # ---------------------------------------------------------------------------
 # DS2 pipeline
 # ---------------------------------------------------------------------------

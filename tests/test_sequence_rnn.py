@@ -151,10 +151,19 @@ class TestSequenceParallelTraining:
 
         return CTCCriterion(blank_id=0)(log_probs, labels)
 
+    @pytest.mark.slow
     def test_gradient_parity_2d_mesh(self):
         """grad of the CTC loss through the sequence-parallel TRAIN
         forward (batch-stats BN) == grad through flax apply(train=True),
-        and the updated running stats match the mutable apply's."""
+        and the updated running stats match the mutable apply's.
+
+        ``slow``: compiling value_and_grad through the shard_map forward
+        on the 8-way virtual (2,4) mesh costs ~40 s of tier-1 wall on
+        the 2-core host (the suite is at its 870 s budget, ISSUE 12);
+        the 1D forward parity, the 2D train-loss-decrease e2e and the
+        ring-attention grad tests keep the sequence-parallel path
+        pinned in tier-1, and this full grad+stats parity runs in the
+        slow lane."""
         model, x, variables, labels = self._setup()
         mesh = create_mesh((2, 4), axis_names=("data", "sequence"))
 

@@ -34,6 +34,15 @@ def main() -> int:
                    help="val-set seed (train_shapes_e2e uses seed 1 for "
                         "its val split)")
     p.add_argument("--out", default="INT8_MAP_PARITY.json")
+    p.add_argument("--backend", default="fused",
+                   choices=("fused", "pallas", "xla", "auto"),
+                   help="DetectionOutput backend for every served config "
+                        "(default: the FUSED single-kernel program, "
+                        "interpret-mode off-TPU) — quantized-ACCURACY "
+                        "numbers then come from the same device program "
+                        "the serving tiers dispatch and the serve-latency "
+                        "bench measures (bench.py ssd_detout), not a "
+                        "parallel decomposition that could drift")
     p.add_argument("--approx", action="store_true",
                    help="also evaluate fp serving with "
                         "DetectionOutputParam(approx_topk=True) — the "
@@ -67,11 +76,11 @@ def main() -> int:
         pre = PreProcessParam(batch_size=args.batch_size, resolution=res,
                               max_gt=8)
         results = {}
-        configs = [("fp", False, DetectionOutputParam(n_classes=n_classes)),
-                   ("int8_weight_only", True,
-                    DetectionOutputParam(n_classes=n_classes)),
-                   ("int8_compute", "int8",
-                    DetectionOutputParam(n_classes=n_classes))]
+        post = DetectionOutputParam(n_classes=n_classes,
+                                    backend=args.backend)
+        configs = [("fp", False, post),
+                   ("int8_weight_only", True, post),
+                   ("int8_compute", "int8", post)]
         if args.approx:
             if jax.default_backend() not in ("tpu", "axon"):
                 # CPU lowers approx_max_k exactly AND runs the pallas
@@ -102,6 +111,7 @@ def main() -> int:
         "task": "VOC07 mAP of ONE trained SSD served fp vs int8 "
                 "(weight-only and real int8 compute), same val set",
         "resolution": res, "val_images": args.val_images,
+        "detout_backend": args.backend,
         "map": {k: round(v, 4) for k, v in results.items()},
         "delta_weight_only": round(results["int8_weight_only"]
                                    - results["fp"], 6),

@@ -1,28 +1,35 @@
 """Decompose the SSD serve program: backbone vs DetectionOutput, and
-DetectionOutput's internals (decode+top_k vs the pallas suppression sweep
-vs the global keep-topk).
+DetectionOutput's internals as a stage ladder that SUMS.
 
-Coherence contract (round-5): the decomposition must SUM — ``full ≈
-backbone + detection_output (+ small jit-boundary residual)``.  The
-round-4 version violated this: the full program ran untrained init
-params (dense, near-uniform softmax → the sweep's slow path) while the
-standalone DetectionOutput stage was fed synthetic sparse
-"trained-like" conf, so ``detout_fraction_of_serve`` divided a
-sparse-case numerator by a dense-case denominator.  Now:
+Coherence contract, two levels:
 
-- the init params get a trained-like prior baked in: every conf head's
-  BACKGROUND bias channel (layout ``a*C + 0`` — see
-  ``models/ssd.py:224-227``) is shifted +bg_bias, so the full program's
-  internal softmax is background-dominated exactly like a trained SSD's
-  (reference ``common/nn/DetectionOutput.scala:171`` serves post-softmax
-  scores with conf_thresh=0.01 killing the vast majority);
-- every standalone stage (detout, decode+topk, sweep, final topk) is
-  timed on the (loc, conf) the biased backbone ACTUALLY produced, not a
-  synthetic distribution — parts and whole see the same data;
-- the residual ``full - (backbone + detout)`` is reported explicitly.
+1. **Program level** (round-5): ``full ≈ backbone + detection_output
+   (+ small jit-boundary residual)``, with the residual reported
+   explicitly.  The trained-like conf distribution is baked into the
+   conf-head biases (+bg_bias on the background channel, layout
+   ``a*C + 0`` — see ``models/ssd.py:224-227``) so whole and parts see
+   the same data; every standalone stage is timed on the (loc, conf)
+   the biased backbone ACTUALLY produced.
+
+2. **DetectionOutput level** (round-9): the internals ladder must sum
+   to the DetectionOutput total.  The pre-r9 version violated this —
+   it timed the PALLAS path's internals (decode+topk 21 + sweep 60 +
+   final topk 5 ≈ 86 ms) under a DetectionOutput total measured on
+   whatever backend ``auto`` resolved to (518 ms on CPU → a −423 ms
+   term no stage owned).  The fused backend
+   (``ops/pallas_detout.py``) makes the ladder coherent BY
+   CONSTRUCTION: each rung is a PREFIX program of the same kernel
+   (``stage="decode" | "select" | "full"``), so rung deltas are stage
+   costs and they telescope to the fused total exactly; the only
+   incoherence left is window noise, reported as
+   ``detout_ladder_residual_fraction``.
+
+``--backend pallas`` keeps the legacy four-stage decomposition for
+comparison (its parts do NOT sum — that is the point).
 
 Usage (on the TPU):  python tools/profile_serve.py --batch 128
-Artifact: SERVE_PROFILE.json
+Artifact: SERVE_PROFILE.json (run_metadata-stamped, linted by
+tools/check_artifacts.py as a STAMPED artifact since r9)
 """
 
 import argparse
@@ -97,6 +104,13 @@ def main() -> int:
                    help="background-logit shift baked into the conf head "
                         "biases; 0 reproduces the untrained dense-conf "
                         "slow path for comparison")
+    p.add_argument("--backend", default="fused",
+                   choices=("fused", "pallas", "xla"),
+                   help="DetectionOutput backend for BOTH the full "
+                        "program and the standalone stages (the pre-r9 "
+                        "incoherence was mixing them); 'fused' adds the "
+                        "prefix-program stage ladder that sums by "
+                        "construction")
     args = p.parse_args()
 
     import jax
@@ -104,6 +118,7 @@ def main() -> int:
     import numpy as np
 
     from analytics_zoo_tpu.models.ssd import SSDDetector, SSDVgg, build_priors
+    from analytics_zoo_tpu.obs import run_metadata
     from analytics_zoo_tpu.ops.detection_output import (
         DetectionOutputParam, detection_output)
     from analytics_zoo_tpu.ops.bbox import decode_bbox
@@ -112,7 +127,7 @@ def main() -> int:
 
     on_tpu = jax.default_backend() in ("tpu", "axon")
     B, res, C = args.batch, args.res, args.classes
-    post = DetectionOutputParam(n_classes=C, backend="auto")
+    post = DetectionOutputParam(n_classes=C, backend=args.backend)
 
     rng = jax.random.PRNGKey(0)
     det = SSDDetector(num_classes=C, resolution=res, post=post)
@@ -147,100 +162,162 @@ def main() -> int:
     def detout(l, c):
         return detection_output(l, c, priors, variances, post)
 
-    # -- DetectionOutput internals (mirrors _detection_output_pallas) -----
     k = min(_round_up(post.nms_topk, 128), _round_up(P, 128))
-
-    from functools import partial as _partial
-
-    Cf = C - 1   # mirrors the fg-only pallas path (background dropped)
-
-    @_partial(jax.jit, static_argnames=("approx",))
-    def stage_topk(loc, conf, approx=False):
-        decoded = jax.vmap(
-            lambda l: decode_bbox(priors, variances, l, clip=False))(loc)
-        scores = jnp.swapaxes(conf[..., 1:], 1, 2)          # (B,Cf,P)
-        masked = jnp.where(scores > post.conf_thresh, scores, -jnp.inf)
-        kk = min(k, P)
-        if approx:
-            top_scores, top_idx = jax.lax.approx_max_k(masked, kk)
-        else:
-            top_scores, top_idx = jax.lax.top_k(masked, kk)
-        if kk < k:   # pad to the sweep's lane count, as the real
-            # _detection_output_pallas does (advisor r4: unpadded lanes
-            # break the arange(k) mask below for small prior counts)
-            pad = k - kk
-            top_scores = jnp.pad(top_scores, ((0, 0), (0, 0), (0, pad)),
-                                 constant_values=-jnp.inf)
-            top_idx = jnp.pad(top_idx, ((0, 0), (0, 0), (0, pad)))
-        boxes = jnp.take_along_axis(decoded[:, None], top_idx[..., None],
-                                    axis=2)
-        return top_scores, top_idx, boxes
-
-    top_scores, top_idx, boxes = jax.block_until_ready(stage_topk(loc, conf))
-    valid = (jnp.isfinite(top_scores)
-             & (jnp.arange(k) < post.nms_topk)).astype(jnp.float32)
-
-    def flat(a):
-        return a.reshape(B * Cf, k)
-
-    fx1, fy1, fx2, fy2 = (flat(boxes[..., i]) for i in range(4))
-    fvalid = flat(valid)
-
-    @jax.jit
-    def stage_sweep(x1, y1, x2, y2, v):
-        return nms_sweep(x1, y1, x2, y2, v, iou_threshold=post.nms_thresh,
-                         interpret=not on_tpu)
-
-    keep = jax.block_until_ready(stage_sweep(fx1, fy1, fx2, fy2, fvalid))
-
-    @jax.jit
-    def stage_final(top_scores, keep, boxes):
-        kk = keep.reshape(B, Cf, k)
-        sel = jnp.where(jnp.isfinite(top_scores), top_scores, 0.0) * kk
-        out_scores, order = jax.lax.top_k(sel.reshape(B, Cf * k),
-                                          post.keep_topk)
-        out_boxes = jnp.take_along_axis(boxes.reshape(B, Cf * k, 4),
-                                        order[..., None], axis=1)
-        return out_scores, out_boxes
+    Cf = C - 1          # foreground class rows (background dropped)
 
     t_full = timed(full, params, x, iters=args.iters)
     t_backbone = timed(backbone, bb_params, x, iters=args.iters)
     t_detout = timed(detout, loc, conf, iters=args.iters)
-    t_topk = timed(stage_topk, loc, conf, iters=args.iters)
-    try:
-        t_topk_approx = timed(lambda l, c: stage_topk(l, c, approx=True),
-                              loc, conf, iters=args.iters)
-    except Exception as e:   # approx_max_k unsupported on this backend
-        print(f"approx_max_k unavailable: {e}", file=sys.stderr)
-        t_topk_approx = None
-    t_sweep = timed(stage_sweep, fx1, fy1, fx2, fy2, fvalid,
-                    iters=args.iters)
-    t_final = timed(stage_final, top_scores, keep, boxes, iters=args.iters)
-    valid_counts = jax.device_get(jnp.sum(fvalid, axis=1))
-
     residual = t_full - (t_backbone + t_detout)
-    result = {
-        "device": jax.devices()[0].device_kind,
-        "batch": B, "resolution": res, "classes": C, "priors": int(P),
-        "sweep_lanes_k": int(k), "grid_instances": int(B * Cf),
-        "bg_bias": args.bg_bias,
-        "ms": {
-            "full_serve_program": round(t_full * 1e3, 2),
-            "backbone_only": round(t_backbone * 1e3, 2),
-            "detection_output_total": round(t_detout * 1e3, 2),
-            "residual_jit_boundary": round(residual * 1e3, 2),
+
+    # candidate-population stat on the SAME conf the stages ran on
+    valid_counts = np.asarray(jnp.sum(
+        (jnp.swapaxes(conf[..., 1:], 1, 2)
+         > post.conf_thresh).astype(jnp.float32), axis=-1)).reshape(-1)
+
+    ms = {
+        "full_serve_program": round(t_full * 1e3, 2),
+        "backbone_only": round(t_backbone * 1e3, 2),
+        "detection_output_total": round(t_detout * 1e3, 2),
+        "residual_jit_boundary": round(residual * 1e3, 2),
+    }
+    detout_coherence = None
+
+    if args.backend == "fused":
+        # the fused stage ladder: each rung a PREFIX program of the ONE
+        # kernel, so rung deltas are stage costs and telescope to the
+        # full-kernel time exactly — the only residual left vs the
+        # detection_output total (same program, timed independently)
+        # is window noise
+        from analytics_zoo_tpu.ops.pallas_detout import (
+            fused_detection_output)
+
+        def stage_fn(stage):
+            return jax.jit(lambda l, c: fused_detection_output(
+                l, c, priors, variances, param=post,
+                interpret=not on_tpu, stage=stage))
+
+        t_decode = timed(stage_fn("decode"), loc, conf, iters=args.iters)
+        t_select = timed(stage_fn("select"), loc, conf, iters=args.iters)
+        t_kernel = timed(stage_fn("full"), loc, conf, iters=args.iters)
+        ms.update({
+            "detout_ladder_decode_and_stream": round(t_decode * 1e3, 2),
+            "detout_ladder_select_and_sweep":
+                round((t_select - t_decode) * 1e3, 2),
+            "detout_ladder_global_topk_merge":
+                round((t_kernel - t_select) * 1e3, 2),
+            "detout_full_kernel": round(t_kernel * 1e3, 2),
+        })
+        detout_coherence = {
+            "ladder_sum_ms": round(t_kernel * 1e3, 2),
+            "detout_total_ms": round(t_detout * 1e3, 2),
+            "ladder_residual_fraction": round(
+                (t_detout - t_kernel) / max(t_detout, 1e-9), 3),
+            "note": "rungs are prefix programs of one kernel — deltas "
+                    "sum to the full-kernel time BY CONSTRUCTION; the "
+                    "residual vs detection_output_total is window noise "
+                    "between two timings of the same program",
+        }
+    elif args.backend == "pallas":
+        # legacy four-stage decomposition (pre-r9): its parts do NOT
+        # tile the detout total — selection/gather work between the
+        # staged programs has no owner.  Kept for comparison.
+        from functools import partial as _partial
+
+        @_partial(jax.jit, static_argnames=("approx",))
+        def stage_topk(loc, conf, approx=False):
+            decoded = jax.vmap(
+                lambda l: decode_bbox(priors, variances, l, clip=False))(loc)
+            scores = jnp.swapaxes(conf[..., 1:], 1, 2)      # (B,Cf,P)
+            masked = jnp.where(scores > post.conf_thresh, scores, -jnp.inf)
+            kk = min(k, P)
+            if approx:
+                top_scores, top_idx = jax.lax.approx_max_k(masked, kk)
+            else:
+                top_scores, top_idx = jax.lax.top_k(masked, kk)
+            if kk < k:   # pad to the sweep's lane count, as the real
+                # _detection_output_pallas does (advisor r4: unpadded
+                # lanes break the arange(k) mask for small prior counts)
+                pad = k - kk
+                top_scores = jnp.pad(top_scores, ((0, 0), (0, 0), (0, pad)),
+                                     constant_values=-jnp.inf)
+                top_idx = jnp.pad(top_idx, ((0, 0), (0, 0), (0, pad)))
+            boxes = jnp.take_along_axis(decoded[:, None], top_idx[..., None],
+                                        axis=2)
+            return top_scores, top_idx, boxes
+
+        top_scores, top_idx, boxes = jax.block_until_ready(
+            stage_topk(loc, conf))
+        valid = (jnp.isfinite(top_scores)
+                 & (jnp.arange(k) < post.nms_topk)).astype(jnp.float32)
+
+        def flat(a):
+            return a.reshape(B * Cf, k)
+
+        fx1, fy1, fx2, fy2 = (flat(boxes[..., i]) for i in range(4))
+        fvalid = flat(valid)
+
+        @jax.jit
+        def stage_sweep(x1, y1, x2, y2, v):
+            return nms_sweep(x1, y1, x2, y2, v,
+                             iou_threshold=post.nms_thresh,
+                             interpret=not on_tpu)
+
+        keep = jax.block_until_ready(stage_sweep(fx1, fy1, fx2, fy2, fvalid))
+
+        @jax.jit
+        def stage_final(top_scores, keep, boxes):
+            kk_ = keep.reshape(B, Cf, k)
+            sel = jnp.where(jnp.isfinite(top_scores), top_scores, 0.0) * kk_
+            out_scores, order = jax.lax.top_k(sel.reshape(B, Cf * k),
+                                              post.keep_topk)
+            out_boxes = jnp.take_along_axis(boxes.reshape(B, Cf * k, 4),
+                                            order[..., None], axis=1)
+            return out_scores, out_boxes
+
+        t_topk = timed(stage_topk, loc, conf, iters=args.iters)
+        try:
+            t_topk_approx = timed(lambda l, c: stage_topk(l, c, approx=True),
+                                  loc, conf, iters=args.iters)
+        except Exception as e:   # approx_max_k unsupported on this backend
+            print(f"approx_max_k unavailable: {e}", file=sys.stderr)
+            t_topk_approx = None
+        t_sweep = timed(stage_sweep, fx1, fy1, fx2, fy2, fvalid,
+                        iters=args.iters)
+        t_final = timed(stage_final, top_scores, keep, boxes,
+                        iters=args.iters)
+        ms.update({
             "detout_decode_topk": round(t_topk * 1e3, 2),
             "detout_decode_topk_approx": (
                 None if t_topk_approx is None
                 else round(t_topk_approx * 1e3, 2)),
             "detout_pallas_sweep": round(t_sweep * 1e3, 2),
             "detout_final_topk": round(t_final * 1e3, 2),
-        },
+        })
+        parts = t_topk + t_sweep + t_final
+        detout_coherence = {
+            "ladder_sum_ms": round(parts * 1e3, 2),
+            "detout_total_ms": round(t_detout * 1e3, 2),
+            "ladder_residual_fraction": round(
+                (t_detout - parts) / max(t_detout, 1e-9), 3),
+            "note": "legacy decomposition: staged sub-programs re-built "
+                    "outside the dispatched path — the residual is real "
+                    "unattributed work (the r9 fused ladder closes it)",
+        }
+
+    result = {
+        "device": jax.devices()[0].device_kind,
+        "batch": B, "resolution": res, "classes": C, "priors": int(P),
+        "detout_backend": args.backend,
+        "sweep_lanes_k": int(k), "grid_instances": int(B * Cf),
+        "bg_bias": args.bg_bias,
+        "ms": ms,
         "coherence": {
             "parts_sum_ms": round((t_backbone + t_detout) * 1e3, 2),
             "full_ms": round(t_full * 1e3, 2),
             "residual_fraction": round(residual / max(t_full, 1e-9), 3),
         },
+        "detout_coherence": detout_coherence,
         "conf_distribution": (
             "untrained dense (bg_bias=0)" if args.bg_bias == 0 else
             f"trained-like: background bias +{args.bg_bias} baked into "
@@ -255,7 +332,14 @@ def main() -> int:
         "images_per_sec_backbone_only": round(B / t_backbone, 1),
         "note": "device-resident inputs; scalar-readback-fenced windows; "
                 "bf16 backbone compute to match the serve path; whole and "
-                "parts share one conf distribution (see module docstring)",
+                "parts share one conf distribution AND one backend (see "
+                "module docstring); off-TPU the pallas/fused kernels run "
+                "interpret-mode — absolute ms are emulation, the "
+                "coherence contract is what a CPU run banks",
+        "run_metadata": run_metadata(
+            "profile_serve", seed=0,
+            extra={"iters": args.iters, "bg_bias": args.bg_bias,
+                   "detout_backend": args.backend}),
     }
     print(json.dumps(result, indent=2))
     with open(args.out, "w") as f:
