@@ -163,8 +163,42 @@ def _ds2(mesh) -> List[AuditProgram]:
                             args=(variables, _S((B, T, MELS), np.float32)),
                             specs=specs)
 
+    def build_pallas_train() -> BuiltProgram:
+        # the persistent-RNN engine's TRAIN program (ISSUE 13): the
+        # custom_vjp backward is the transposed persistent Pallas
+        # kernel since r10, so the jaxpr audit must trace the
+        # pallas-engine training pipeline — not just the default
+        # blocked-scan one — or the kernel path (fwd AND bwd pallas
+        # calls, the programs bench.py ds2_persistent measures) sits
+        # outside the audit surface.  Traces interpret-mode off-TPU,
+        # same as the program the CPU tier dispatches.
+        from analytics_zoo_tpu.models import DeepSpeech2
+        from analytics_zoo_tpu.parallel import (Adam, make_train_step,
+                                                pipeline_specs)
+        from analytics_zoo_tpu.pipelines.deepspeech2 import (
+            ds2_ctc_criterion, ds2_padding_metric)
+
+        module = DeepSpeech2(hidden=16, n_rnn_layers=1, n_mels=MELS,
+                             rnn_engine="pallas")
+        specs = pipeline_specs("ds2", mesh=mesh)
+        optim = Adam(1e-3)
+        _, state = abstract_train_state(
+            module, optim, _S((1, T, MELS), np.float32))
+        step = make_train_step(module, ds2_ctc_criterion(), optim,
+                               specs=specs, state=state,
+                               metric_fn=ds2_padding_metric)
+        B = specs.data_axis_size
+        batch = {"input": (_S((B, T, MELS), np.float32),
+                           _S((B,), np.int32)),
+                 "n_frames": _S((B,), np.int32),
+                 "labels": _S((B, LAB), np.int32),
+                 "label_mask": _S((B, LAB), np.float32)}
+        return BuiltProgram(fn=step, args=(state, batch, 1.0),
+                            specs=specs, donate_state=state)
+
     return [AuditProgram("ds2/train", build_train),
-            AuditProgram("ds2/eval", build_eval)]
+            AuditProgram("ds2/eval", build_eval),
+            AuditProgram("ds2-pallas/train", build_pallas_train)]
 
 
 def _ssd(mesh) -> List[AuditProgram]:

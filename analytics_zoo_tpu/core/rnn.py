@@ -41,10 +41,15 @@ explicitly; all three share ONE parameter tree (checkpoints move freely):
 - ``"pallas"`` — the persistent-RNN kernel (``ops.pallas_rnn``): the
   h2h weights load into VMEM once and the timestep loop runs on-chip,
   breaking the ≈ B/240 HBM-restream roofline of docs/MFU_CEILING.md
-  (Diamos et al., "Persistent RNNs", ICML 2016).  Falls back to
-  ``"blocked"`` with a warning when the geometry cannot be
-  VMEM-resident (budget formula: ``persistent_vmem_bytes``) or the
-  cell kind is not ported into the kernel.
+  (Diamos et al., "Persistent RNNs", ICML 2016).  The grad pass is the
+  matching TRANSPOSED persistent kernel (``pallas_backward="pallas"``,
+  Diamos §4): reversed time grid with ``W``/``Wᵀ`` VMEM-resident and
+  the dW accumulation fused in VMEM scratch, so the backward's h2h
+  intensity decouples from batch exactly like the forward's.  Falls
+  back to ``"blocked"`` with a warning when the geometry cannot be
+  VMEM-resident (budget formula: ``persistent_vmem_bytes`` — priced
+  for BOTH passes; the warning names which overflowed) or the cell
+  kind is not ported into the kernel.
 """
 
 from __future__ import annotations
@@ -312,6 +317,17 @@ class Recurrent(nn.Module):
     # device count — right for pure data parallelism; set explicitly on
     # tensor-parallel meshes whose data axis is smaller.
     pallas_data_shards: Optional[int] = None
+    # grad-pass engine: "pallas" = the transposed persistent backward
+    # (W/Wᵀ VMEM-resident, fused dW accumulation); "scan" = the
+    # reference-scan recompute vjp (bit-compatible pre-r10 behavior)
+    pallas_backward: str = "pallas"
+    # whether the VMEM budget prices the transposed BACKWARD program
+    # too (its residency is strictly larger: W and Wᵀ resident plus the
+    # fp32 dW accumulator).  True is the training-safe default — a
+    # geometry that fits fwd-only but not fwd+bwd falls back BEFORE
+    # compile.  Set False for inference-only programs so fwd-only
+    # geometries keep the kernel.
+    pallas_grad: bool = True
 
     def _resolve_engine(self) -> str:
         eng = self.engine
@@ -342,17 +358,31 @@ class Recurrent(nn.Module):
         # default, bf16 under make_train_step(compute_dtype='bf16')
         # casting) and the PER-DEVICE batch: a pre-sharded global batch
         # traces with the global row count, but each core only holds
-        # global/shards rows of the streaming working set
+        # global/shards rows of the streaming working set.  BOTH passes
+        # are priced (pallas_grad=True): the transposed backward holds
+        # W AND Wᵀ resident plus the fp32 dW accumulator, so a training
+        # geometry can fit fwd-only yet overflow on the grad pass — it
+        # must fall back BEFORE compile, with the warning naming the
+        # overflowing pass.
         shards = self.pallas_data_shards or max(jax.device_count(), 1)
-        need = pallas_rnn.persistent_vmem_bytes(
-            self.cell.hidden_size, kind, batch=-(-batch // shards),
-            time_block=self.pallas_time_block,
-            weight_bytes=jnp.dtype(dtype).itemsize)
-        if need > limit:
+        size_kwargs = dict(batch=-(-batch // shards),
+                           time_block=self.pallas_time_block,
+                           weight_bytes=jnp.dtype(dtype).itemsize)
+        need = {"forward": pallas_rnn.persistent_vmem_bytes(
+            self.cell.hidden_size, kind, **size_kwargs)}
+        if self.pallas_grad and self.pallas_backward == "pallas":
+            need["backward"] = pallas_rnn.persistent_vmem_bytes(
+                self.cell.hidden_size, kind, backward=True, **size_kwargs)
+        over = {p: nb for p, nb in need.items() if nb > limit}
+        if over:
+            detail = ", ".join(f"{p} ~{nb / 2**20:.1f} MB"
+                               for p, nb in over.items())
             warnings.warn(
-                f"persistent-RNN kernel needs ~{need / 2**20:.1f} MB VMEM "
-                f"(H={self.cell.hidden_size}, {kind}) > budget "
-                f"{limit / 2**20:.1f} MB — falling back to the blocked scan")
+                f"persistent-RNN kernel over the {limit / 2**20:.1f} MB "
+                f"VMEM budget on the {'+'.join(over)} pass"
+                f"{'es' if len(over) > 1 else ''} ({detail}; "
+                f"H={self.cell.hidden_size}, {kind}) — falling back to "
+                f"the blocked scan")
             return None
         return kind
 
@@ -510,7 +540,8 @@ class Recurrent(nn.Module):
         act = getattr(self.cell, "activation", "relu")
         ys, cf = persistent_rnn(pre, w, b, h0, n, cell=kind,
                                 activation=act,
-                                time_block=self.pallas_time_block)
+                                time_block=self.pallas_time_block,
+                                backward=self.pallas_backward)
         if self.reverse:
             ys = (jnp.take_along_axis(ys, perm[..., None], axis=1)
                   if perm is not None else jnp.flip(ys, axis=1))
@@ -540,6 +571,8 @@ class BiRecurrent(nn.Module):
     engine: Optional[str] = None
     pallas_time_block: int = 8
     pallas_data_shards: Optional[int] = None
+    pallas_backward: str = "pallas"
+    pallas_grad: bool = True
 
     @nn.compact
     def __call__(self, x, n_frames=None):
@@ -547,12 +580,16 @@ class BiRecurrent(nn.Module):
                         block_size=self.block_size, engine=self.engine,
                         pallas_time_block=self.pallas_time_block,
                         pallas_data_shards=self.pallas_data_shards,
+                        pallas_backward=self.pallas_backward,
+                        pallas_grad=self.pallas_grad,
                         name="fwd")(
             x, n_frames=n_frames)
         bwd = Recurrent(cell=self.cell, reverse=True, hoist=self.hoist,
                         block_size=self.block_size, engine=self.engine,
                         pallas_time_block=self.pallas_time_block,
                         pallas_data_shards=self.pallas_data_shards,
+                        pallas_backward=self.pallas_backward,
+                        pallas_grad=self.pallas_grad,
                         name="bwd")(
             x, n_frames=n_frames)
         if self.merge == "sum":

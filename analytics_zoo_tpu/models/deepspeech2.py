@@ -93,6 +93,15 @@ class DeepSpeech2(nn.Module):
     # docs/MFU_CEILING.md ceiling-raising lever); params are identical
     # across engines, so checkpoints move freely.
     rnn_engine: Optional[str] = None
+    # pallas-engine grad knobs (core.rnn.Recurrent): the backward's
+    # engine ("pallas" = transposed persistent kernel, "scan" = the
+    # recompute vjp — e.g. H=1760 bf16, whose backward residency
+    # overflows the VMEM budget), and whether the VMEM budget prices
+    # the backward pass too.  Forward-only programs (inference,
+    # bench fwd sub-phases) set rnn_pallas_grad=False so a
+    # backward-only overflow does not fell the forward kernel.
+    rnn_pallas_backward: str = "pallas"
+    rnn_pallas_grad: bool = True
 
     @nn.compact
     def __call__(self, x, n_frames=None, train: bool = False, carry=None,
@@ -150,12 +159,16 @@ class DeepSpeech2(nn.Module):
                                 hoist=self.rnn_hoist,
                                 block_size=self.rnn_block,
                                 engine=self.rnn_engine,
+                                pallas_backward=self.rnn_pallas_backward,
+                                pallas_grad=self.rnn_pallas_grad,
                                 name=f"birnn{i}")(h, n_frames=out_n)
             else:
                 h0 = carry["h"][i] if carry is not None else None
                 h, hN = Recurrent(cell=cell, hoist=self.rnn_hoist,
                                   block_size=self.rnn_block,
                                   engine=self.rnn_engine,
+                                  pallas_backward=self.rnn_pallas_backward,
+                                  pallas_grad=self.rnn_pallas_grad,
                                   name=f"rnn{i}")(
                     h, carry0=h0, return_carry=True, n_frames=out_n)
                 new_h.append(hN)

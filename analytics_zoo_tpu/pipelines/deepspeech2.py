@@ -287,19 +287,26 @@ def make_ds2_model(hidden: int = 1024, n_rnn_layers: int = 3,
                    n_mels: int = 13, utt_length: int = 300,
                    seed: int = 0, bidirectional: bool = True,
                    rnn_hoist: bool = True, rnn_block: int = 16,
-                   rnn_engine: Optional[str] = None) -> Model:
+                   rnn_engine: Optional[str] = None,
+                   rnn_pallas_backward: str = "pallas",
+                   rnn_pallas_grad: bool = True) -> Model:
     """``bidirectional=False`` builds the forward-only (streamable)
     variant consumed by :class:`StreamingDS2`.  ``rnn_hoist=False``
     selects the legacy per-step scan body (the bench A/B baseline);
     ``rnn_engine`` overrides the recurrence engine explicitly
     ("legacy" | "blocked" | "pallas" — "pallas" is the persistent-RNN
     kernel of ``ops.pallas_rnn``, which ``train_ds2`` consumes through
-    the model).  The parameter tree is identical across engines, so
+    the model; ``rnn_pallas_backward``/``rnn_pallas_grad`` are its
+    grad-pass knobs — forward-only consumers pass
+    ``rnn_pallas_grad=False`` so the VMEM budget prices only the
+    forward).  The parameter tree is identical across engines, so
     checkpoints move freely between them."""
     model = Model(DeepSpeech2(hidden=hidden, n_rnn_layers=n_rnn_layers,
                               n_mels=n_mels, bidirectional=bidirectional,
                               rnn_hoist=rnn_hoist, rnn_block=rnn_block,
-                              rnn_engine=rnn_engine))
+                              rnn_engine=rnn_engine,
+                              rnn_pallas_backward=rnn_pallas_backward,
+                              rnn_pallas_grad=rnn_pallas_grad))
     model.build(seed, jnp.zeros((1, utt_length, n_mels)))
     return model
 

@@ -698,23 +698,40 @@ def bench_ds2_ragged(args, mesh):
 
 
 def bench_ds2_persistent(args, mesh):
-    """Persistent-RNN kernel A/B (ISSUE 6): ``rnn_engine='blocked'`` vs
-    ``rnn_engine='pallas'`` at EQUAL geometry — same seeded ragged
-    length distribution, same quantile buckets, same n_frames masking
-    and masked CTC on both sides; the ONLY variable is the recurrence
-    engine.  Interleaved drift-cancelling windows with per-window
-    values, plus the achieved-intensity readout: the h2h term's
-    arithmetic intensity under each engine (weights re-streamed per
-    step vs loaded once per sequence) against the v5e ridge of ~240
-    FLOP/byte, and a blended mfu_est from XLA's compiled FLOP count.
+    """Persistent-RNN kernel A/B (ISSUE 6, extended by ISSUE 13):
+    ``rnn_engine='blocked'`` vs ``rnn_engine='pallas'`` at EQUAL
+    geometry — same seeded ragged length distribution, same quantile
+    buckets, same n_frames masking and masked CTC on both sides; the
+    ONLY variable is the recurrence engine.  TWO sub-phases per hidden
+    size, each its own interleaved drift-cancelling A/B:
 
-    On a CPU backend the pallas kernel runs interpret-mode (discharged
-    to XLA): the A/B then banks SCHEDULE parity/overhead, not the HBM
-    term — weight residency only pays on a real TPU, where the blocked
-    side's per-step weight restream is the structural ~B/240 ceiling
-    (docs/MFU_CEILING.md).  The backend is recorded on every line."""
+    * **fwd** — the forward program only (jitted masked BiRNN forward
+      to a scalar fence): the r7 residency story.
+    * **train** — the full train step (fwd+bwd+Adam update): since r10
+      the pallas side's backward is the TRANSPOSED persistent kernel
+      (reversed time grid, W/Wᵀ VMEM-resident, fused dW accumulation)
+      instead of the recompute-through-scan vjp — the grad-dominated
+      pass the ≈B/128 ceiling was derived for.
+
+    ``engine_fallback`` is recorded **per pass per line** (the budget
+    warning names which pass overflowed): a fallen-back backward must
+    not bank a scan-vs-scan ratio unnoticed.  Every line carries the
+    achieved-intensity readout for its pass — the h2h term's FLOP/byte
+    under each engine's weight-streaming discipline (re-streamed per
+    step vs loaded once per sequence; the backward moves 2× the
+    forward's h2h FLOPs against W *and* Wᵀ, so its persistent/blocked
+    intensity RATIO is the forward's T′) against the v5e ridge of ~240,
+    plus a blended mfu_est from XLA's compiled FLOP count.
+
+    On a CPU backend both kernels run interpret-mode (discharged to
+    XLA): the A/B then banks SCHEDULE parity/overhead, not the HBM
+    term — weight residency only pays on a real TPU.  The backend is
+    recorded on every line.  ``--ds2-persistent-out`` additionally
+    banks the phase's lines as one run_metadata-stamped artifact (the
+    BENCH_r10.json path)."""
     import numpy as np
     import jax
+    import jax.numpy as jnp
 
     from analytics_zoo_tpu.core.rnn import Recurrent
     from analytics_zoo_tpu.parallel import (Adam, create_train_state,
@@ -724,6 +741,7 @@ def bench_ds2_persistent(args, mesh):
         ds2_ctc_criterion, make_ds2_model)
     from analytics_zoo_tpu.transform.audio.featurize import (
         WINDOW_SIZE, WINDOW_STRIDE)
+    from tools.profile_mfu import flops_of
 
     sec = args.ds2_seconds
     n_max = (16000 * sec - WINDOW_SIZE) // WINDOW_STRIDE + 1
@@ -739,10 +757,11 @@ def bench_ds2_persistent(args, mesh):
     reps = max(1, max(4, args.steps // 3) // max(len(batches), 1))
     criterion = ds2_ctc_criterion()
     dt_bytes = 2 if args.compute_dtype in ("bf16", "bfloat16") else 4
+    emitted = []
     last = None
     for hidden in (args.ds2_hidden, 1760) if not args.quick \
             else (args.ds2_hidden,):
-        sides, side_fpr, side_fb = {}, {}, {}
+        sides, info = {}, {}
         for engine in ("blocked", "pallas"):
             model = make_ds2_model(hidden=hidden,
                                    n_rnn_layers=args.ds2_layers,
@@ -755,23 +774,65 @@ def bench_ds2_persistent(args, mesh):
                                    mesh=mesh,
                                    compute_dtype=args.compute_dtype)
             dev = [mesh_lib.shard_batch(b, mesh) for b in batches]
-            # the pallas engine warns and runs the blocked scan when the
-            # geometry cannot be VMEM-resident — record that, or the
-            # 'pallas' line could silently bank a blocked-vs-blocked
-            # A/B.  Capture ONLY around the measured step's compiles:
-            # make_ds2_model's fp32 batch-1 build trace above can warn
-            # at geometries where the actual compute-dtype step fits.
-            with warnings.catch_warnings(record=True) as caught:
+            # the train step DONATES its state buffers and
+            # model.variables aliases them (the profile_mfu caveat) —
+            # the fwd sub-phase needs its own device copy
+            variables = jax.device_put(jax.device_get(model.variables))
+            # the fwd sub-phase is a forward-only program: price only
+            # the forward's VMEM residency, or a backward-only budget
+            # overflow (possible on TPU, e.g. H=1760 bf16) would fell
+            # the forward kernel too and bank blocked-vs-blocked
+            fwd_module = model.module.clone(rnn_pallas_grad=False)
+
+            def jfwd_fn(v, x, nf, module=fwd_module):
+                # scalar output = cheap readback fence, identical on
+                # both sides (the forward sub-phase's program)
+                return jnp.sum(module.apply(v, x, nf))
+
+            jfwd = jax.jit(jfwd_fn)
+
+            # the pallas engine warns and runs the blocked scan when a
+            # pass cannot be VMEM-resident — capture PER SUB-PHASE
+            # around each program's compiles (make_ds2_model's fp32
+            # batch-1 build trace above can warn at geometries where
+            # the measured program fits), and attribute per PASS from
+            # the warning text (the budget warning names which of
+            # forward/backward overflowed): a fallen-back backward
+            # banking a scan-vs-scan ratio is the failure mode this
+            # field exists to expose.
+            with warnings.catch_warnings(record=True) as caught_f:
                 warnings.simplefilter("always")
                 for b in dev:                  # compile each pinned shape
+                    out = jfwd(variables, b["input"][0], b["n_frames"])
+            float(np.asarray(out))             # readback-fenced warmup
+            fwd_msgs = [str(w.message) for w in caught_f
+                        if "falling back" in str(w.message)]
+
+            with warnings.catch_warnings(record=True) as caught_t:
+                warnings.simplefilter("always")
+                for b in dev:
                     state, m = step(state, b, 1.0)
-            side_fb[engine] = any("falling back" in str(w.message)
-                                  for w in caught)
-            float(np.asarray(m["loss"]))       # readback-fenced warmup
-            side_fpr[engine] = _flops_per_record(step, state, dev, recs)
+            float(np.asarray(m["loss"]))
+            train_msgs = [str(w.message) for w in caught_t
+                          if "falling back" in str(w.message)]
+
+            def per_pass(msgs):
+                return {"forward": any("forward" in m for m in msgs),
+                        "backward": any("backward" in m for m in msgs),
+                        "any": bool(msgs)}
+
+            by_shape = {}
+            for b in dev:
+                x = b["input"][0]
+                cnt, ex = by_shape.get(x.shape, (0, b))
+                by_shape[x.shape] = (cnt + 1, ex)
+            fpr_fwd = sum(
+                flops_of(jfwd, variables, ex["input"][0], ex["n_frames"])
+                * cnt for cnt, ex in by_shape.values()) / max(recs, 1)
+            fpr_train = _flops_per_record(step, state, dev, recs)
             hold = {"state": state}
 
-            def run(hold=hold, step=step, dev=dev):
+            def run_train(hold=hold, step=step, dev=dev):
                 t0 = time.perf_counter()
                 m = None
                 s = hold["state"]
@@ -782,70 +843,195 @@ def bench_ds2_persistent(args, mesh):
                 float(np.asarray(m["loss"]))   # fence
                 return recs * reps / (time.perf_counter() - t0) / n_chips
 
-            sides[engine] = run
+            def run_fwd(jfwd=jfwd, variables=variables, dev=dev):
+                t0 = time.perf_counter()
+                out = None
+                for _ in range(reps):
+                    for b in dev:
+                        out = jfwd(variables, b["input"][0],
+                                   b["n_frames"])
+                float(np.asarray(out))         # fence
+                return recs * reps / (time.perf_counter() - t0) / n_chips
 
-        b_rates, p_rates, ratios = _interleaved_ab(sides["blocked"],
-                                                   sides["pallas"])
+            sides[(engine, "fwd")] = run_fwd
+            sides[(engine, "train")] = run_train
+            info[engine] = {
+                "fb": {"fwd": per_pass(fwd_msgs),
+                       "train": per_pass(train_msgs)},
+                "fpr": {"fwd": fpr_fwd, "train": fpr_train},
+            }
+
         # achieved-intensity readout for the h2h term (analytic — the
-        # MFU_CEILING.md roofline algebra): per step per direction the
-        # recurrence does 2·B·H² FLOPs against the H² weight block the
-        # blocked scan re-reads from HBM every step and the persistent
-        # kernel reads once per sequence of T' steps.  PER-CHIP batch:
-        # each core's matmul only runs its own data-parallel shard
+        # MFU_CEILING.md roofline algebra), PER PASS: forward, 2·B·H²
+        # FLOPs/step against the H²·db weight block; backward, 4·B·H²
+        # FLOPs/step (dh ← dgate·Wᵀ + dW += hᵀ·dgate) against BOTH
+        # blocks (2·H²·db) — re-read every step by the blocked/scan
+        # paths, once per sequence of T′ steps by the persistent
+        # kernels.  PER-CHIP batch: each core's matmul only runs its
+        # own data-parallel shard.
         b_chip = max(B // n_chips, 1)
         t_out = (n_max + 1) // 2
         i_blocked = 2.0 * b_chip / dt_bytes
         i_pallas = i_blocked * t_out
 
-        def mfu_of(rate, eng):
-            return rate * side_fpr[eng] / (mfu_peak * 1e12)
+        for sub in ("fwd", "train"):
+            b_rates, p_rates, ratios = _interleaved_ab(
+                sides[("blocked", sub)], sides[("pallas", sub)])
 
-        _emit(f"ds2_persistent_h{hidden}_blocked_records_per_sec_per_chip",
-              _median(b_rates), "records/sec/chip", None, batch=B,
-              hidden=hidden, layers=args.ds2_layers, backend=backend,
-              utterance_seconds=sec, bucket_edges=edges,
-              windows=[round(r, 3) for r in b_rates],
-              mfu_est=round(mfu_of(_median(b_rates), "blocked"), 5),
-              mfu_est_windows=[round(mfu_of(r, "blocked"), 5)
-                               for r in b_rates],
-              flops_per_record_gflop=round(side_fpr["blocked"] / 1e9, 3),
-              mfu_basis=mfu_basis,
-              h2h_intensity_flops_per_byte=round(i_blocked, 1),
-              note="blocked-scan engine (rnn_engine='blocked'): the h2h "
-                   "weight block re-streams from HBM every timestep — "
-                   "intensity ~2B/dtype_bytes vs the v5e ridge ~240")
-        last = _emit(
-            f"ds2_persistent_h{hidden}_pallas_records_per_sec_per_chip",
-            _median(p_rates), "records/sec/chip", _median(ratios),
-            batch=B, hidden=hidden, layers=args.ds2_layers,
-            backend=backend, utterance_seconds=sec, bucket_edges=edges,
-            records=recs, time_block=int(Recurrent.pallas_time_block),
-            windows=[round(r, 3) for r in p_rates],
-            blocked_windows=[round(r, 3) for r in b_rates],
-            ratio_windows=[round(r, 3) for r in ratios],
-            mfu_est=round(mfu_of(_median(p_rates), "pallas"), 5),
-            mfu_est_windows=[round(mfu_of(r, "pallas"), 5)
-                             for r in p_rates],
-            flops_per_record_gflop=round(side_fpr["pallas"] / 1e9, 3),
-            mfu_basis=mfu_basis,
-            h2h_intensity_flops_per_byte=round(i_pallas, 1),
-            h2h_weight_mbytes_per_direction=round(
-                hidden**2 * dt_bytes / 2**20, 2),
-            v5e_ridge_flops_per_byte=240,
-            device_kind=kind,
-            engine_fallback=side_fb["pallas"],
-            note="persistent-RNN Pallas engine (rnn_engine='pallas', "
-                 "ops.pallas_rnn): h2h weights load into VMEM once per "
-                 "sequence — intensity ~2*B*T'/dtype_bytes, decoupled "
-                 "from batch size; engine_fallback=true would mean the "
-                 "geometry could not be VMEM-resident and this side "
-                 "ACTUALLY ran the blocked scan; vs_baseline = median "
-                 "per-pair "
-                 "pallas/blocked records-per-sec ratio, interleaved "
-                 "windows, equal geometry/buckets/masking.  On a CPU "
-                 "backend the kernel runs interpret-mode (discharged "
-                 "to XLA) and the ratio banks schedule parity, not "
-                 "the HBM-residency term")
+            def mfu_of(rate, eng, sub=sub):
+                return rate * info[eng]["fpr"][sub] / (mfu_peak * 1e12)
+
+            sub_note = (
+                "forward program only (jitted masked BiRNN to a scalar "
+                "fence)" if sub == "fwd" else
+                "full train step fwd+bwd+Adam; the pallas backward is "
+                "the r10 TRANSPOSED persistent kernel (reversed grid, "
+                "W/Wt VMEM-resident, fused dW accumulation) — "
+                "bwd_h2h_intensity is its 4BH2-per-step term against "
+                "both resident blocks")
+            emitted.append(_emit(
+                f"ds2_persistent_h{hidden}_{sub}_blocked"
+                "_records_per_sec_per_chip",
+                _median(b_rates), "records/sec/chip", None, batch=B,
+                hidden=hidden, layers=args.ds2_layers, backend=backend,
+                utterance_seconds=sec, bucket_edges=edges, subphase=sub,
+                windows=[round(r, 3) for r in b_rates],
+                mfu_est=round(mfu_of(_median(b_rates), "blocked"), 5),
+                mfu_est_windows=[round(mfu_of(r, "blocked"), 5)
+                                 for r in b_rates],
+                flops_per_record_gflop=round(
+                    info["blocked"]["fpr"][sub] / 1e9, 3),
+                mfu_basis=mfu_basis,
+                engine_fallback=info["blocked"]["fb"][sub],
+                h2h_intensity_flops_per_byte=round(i_blocked, 1),
+                **({"bwd_h2h_intensity_flops_per_byte":
+                    round(i_blocked, 1)} if sub == "train" else {}),
+                note="blocked-scan engine (rnn_engine='blocked'): the "
+                     "h2h weight block re-streams from HBM every "
+                     "timestep on every pass — intensity "
+                     "~2B/dtype_bytes vs the v5e ridge ~240; " + sub_note))
+            last = _emit(
+                f"ds2_persistent_h{hidden}_{sub}_pallas"
+                "_records_per_sec_per_chip",
+                _median(p_rates), "records/sec/chip", _median(ratios),
+                batch=B, hidden=hidden, layers=args.ds2_layers,
+                backend=backend, utterance_seconds=sec,
+                bucket_edges=edges, subphase=sub,
+                records=recs, time_block=int(Recurrent.pallas_time_block),
+                windows=[round(r, 3) for r in p_rates],
+                blocked_windows=[round(r, 3) for r in b_rates],
+                ratio_windows=[round(r, 3) for r in ratios],
+                mfu_est=round(mfu_of(_median(p_rates), "pallas"), 5),
+                mfu_est_windows=[round(mfu_of(r, "pallas"), 5)
+                                 for r in p_rates],
+                flops_per_record_gflop=round(
+                    info["pallas"]["fpr"][sub] / 1e9, 3),
+                mfu_basis=mfu_basis,
+                h2h_intensity_flops_per_byte=round(i_pallas, 1),
+                **({"bwd_h2h_intensity_flops_per_byte":
+                    round(i_pallas, 1)} if sub == "train" else {}),
+                h2h_weight_mbytes_per_direction=round(
+                    hidden**2 * dt_bytes / 2**20, 2),
+                v5e_ridge_flops_per_byte=240,
+                device_kind=kind,
+                engine_fallback=info["pallas"]["fb"][sub],
+                note="persistent-RNN Pallas engine (rnn_engine="
+                     "'pallas', ops.pallas_rnn): h2h weights load into "
+                     "VMEM once per sequence — intensity "
+                     "~2*B*T'/dtype_bytes, decoupled from batch size; "
+                     "engine_fallback records PER PASS (from the "
+                     "budget warning's named pass) whether this side "
+                     "ACTUALLY ran the blocked scan; vs_baseline = "
+                     "median per-pair pallas/blocked records-per-sec "
+                     "ratio, interleaved windows, equal geometry/"
+                     "buckets/masking.  On a CPU backend the kernels "
+                     "run interpret-mode (discharged to XLA) and the "
+                     "ratio banks schedule parity, not the "
+                     "HBM-residency term; " + sub_note)
+            emitted.append(last)
+
+    if getattr(args, "ds2_persistent_out", ""):
+        from analytics_zoo_tpu.obs import run_metadata
+
+        def pick(h, sub, eng):
+            m = (f"ds2_persistent_h{h}_{sub}_{eng}"
+                 "_records_per_sec_per_chip")
+            return next(ln for ln in emitted if ln["metric"] == m)
+
+        hiddens = sorted({ln["hidden"] for ln in emitted})
+        headline = {}
+        for h in hiddens:
+            for sub in ("fwd", "train"):
+                p = pick(h, sub, "pallas")
+                headline[f"pallas_over_blocked_ratio_h{h}_{sub}"] = \
+                    p["vs_baseline"]
+                headline[f"engine_fallback_h{h}_{sub}"] = \
+                    p["engine_fallback"]
+            headline[f"h2h_intensity_pallas_h{h}"] = \
+                pick(h, "train", "pallas")["h2h_intensity_flops_per_byte"]
+            headline[f"bwd_h2h_intensity_pallas_h{h}"] = \
+                pick(h, "train", "pallas")[
+                    "bwd_h2h_intensity_flops_per_byte"]
+        argv = []
+        skip_next = False
+        for a in sys.argv[1:]:
+            if skip_next:
+                argv.append("<all other phases>")
+                skip_next = False
+            elif a == "--skip":
+                argv.append(a)
+                skip_next = True
+            elif a.startswith("--skip="):
+                argv.append("--skip <all other phases>")
+            else:
+                argv.append(a)
+        doc = {
+            "round": 10,
+            "phase": "ds2_persistent",
+            "command": "python bench.py " + " ".join(argv),
+            "backend": backend,
+            "host_cpus": os.cpu_count(),
+            "headline": headline,
+            "policy": (
+                "interleaved drift-cancelling window pairs per "
+                "sub-phase in ONE process (_interleaved_ab, "
+                "alternating order); committed ratio = median of "
+                "per-pair pallas/blocked records-per-sec ratios; "
+                "per-window values kept in each line; EQUAL geometry "
+                "(hidden, layers, batch, optimizer, dtype), the SAME "
+                "seeded ragged length distribution, the SAME quantile "
+                "buckets and n_frames masking on both sides — the "
+                "ONLY variable is the recurrence engine; "
+                "engine_fallback recorded per pass per line (the "
+                "budget warning names the overflowing pass), so a "
+                "fallen-back backward cannot bank a scan-vs-scan "
+                "ratio"),
+            "context": (
+                "ISSUE 13: the grad pass joins the persistent "
+                "formulation.  TRAIN sub-phase = full train step "
+                "(fwd+bwd+Adam) where the pallas side's custom_vjp "
+                "backward is the TRANSPOSED persistent kernel "
+                "(Diamos et al. ICML'16 §4 restated for TPU): "
+                "reversed time grid, W_h2h AND W_h2h^T resident in "
+                "VMEM via constant-index-map BlockSpecs, dh carry in "
+                "fp32 VMEM scratch, dW/db fused-accumulated in fp32 "
+                "VMEM scratch across all time blocks (streamed out "
+                "once at the final grid step), within-block recompute "
+                "from streamed block-boundary carry residuals (T/U "
+                "slabs, not T per-step activations).  FWD sub-phase = "
+                "the forward program alone (the r7 reading, "
+                "re-banked at the same workload for a per-pass "
+                "decomposition).  On this CPU host both kernels run "
+                "interpret-mode: the ratios bank schedule parity; the "
+                "intensity columns (per pass, per line) are the "
+                "HBM-residency term that pays on silicon."),
+            "lines": emitted,
+            "run_metadata": run_metadata("bench_ds2_persistent", seed=0),
+        }
+        with open(args.ds2_persistent_out, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"ds2_persistent: banked {len(emitted)} lines -> "
+              f"{args.ds2_persistent_out}", file=sys.stderr)
     return last
 
 
@@ -2170,6 +2356,11 @@ def main() -> int:
                    help="when set, also write the ssd_detout phase's two "
                         "readings as one run_metadata-stamped artifact "
                         "(the BENCH_r09.json banking path)")
+    p.add_argument("--ds2-persistent-out", default="",
+                   help="when set, also write the ds2_persistent "
+                        "phase's fwd/train A/B lines as one "
+                        "run_metadata-stamped artifact (the "
+                        "BENCH_r10.json banking path)")
     p.add_argument("--ds2-seconds", type=int, default=15)
     p.add_argument("--ds2-batch", type=int, default=8)
     p.add_argument("--ds2-train-batch", type=int, default=0,

@@ -544,6 +544,11 @@ class TestRepoClean:
             assert f"{pipe}/train" in names, names
             assert f"{pipe}/eval" in names, names
         assert {"ssd/serve:fp", "ssd/serve:int8"} <= names
+        # ISSUE 13: the persistent-RNN TRAIN program (pallas engine,
+        # transposed persistent backward) is audited alongside the
+        # default-engine pipeline — a pallas-engine training pipeline
+        # absent from the audit surface fails here
+        assert "ds2-pallas/train" in names
         # ISSUE 12: the FUSED DetectionOutput serving programs (what
         # "auto" dispatches on TPU) are audited like every other rung
         assert {"ssd-fused/serve:fp", "ssd-fused/serve:int8"} <= names

@@ -409,6 +409,66 @@ class TestCheckArtifacts:
             meta = doc["run_metadata"]
             assert all(k in meta for k in REQUIRED_KEYS), name
 
+    def test_issue13_bench_r10_is_stamped_not_grandfathered(self):
+        """ISSUE 13 satellite: the BENCH_r10 banking is covered by the
+        lint as a STAMPED artifact — the LEGACY set stayed closed."""
+        import json
+
+        from tools.check_artifacts import LEGACY, PATTERN, REQUIRED_KEYS
+
+        root = os.path.join(os.path.dirname(__file__), os.pardir)
+        name = "BENCH_r10.json"
+        assert PATTERN.match(name)
+        assert name not in LEGACY, f"{name} must not be grandfathered"
+        doc = json.load(open(os.path.join(root, name)))
+        meta = doc["run_metadata"]
+        assert all(k in meta for k in REQUIRED_KEYS)
+
+    def test_committed_bench_r10_banks_the_train_ab(self):
+        """The r10 artifact's own claims hold: fwd AND train-step
+        sub-phase lines per engine at equal seeded ragged geometry
+        with per-window values, ``engine_fallback`` recorded per pass
+        per line and FALSE everywhere on the banked run
+        (fallback-free — a fallen-back backward cannot bank a
+        scan-vs-scan ratio), and per-pass intensity readouts with the
+        bwd h2h FLOP/byte on every train line."""
+        import json
+
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "BENCH_r10.json")
+        doc = json.load(open(path))
+        assert doc["round"] == 10 and doc["phase"] == "ds2_persistent"
+        lines = doc["lines"]
+        hiddens = sorted({ln["hidden"] for ln in lines})
+        # 2 engines × 2 sub-phases per hidden size
+        assert len(lines) == 4 * len(hiddens) >= 8
+        for ln in lines:
+            fb = ln["engine_fallback"]
+            assert fb == {"forward": False, "backward": False,
+                          "any": False}, ln["metric"]
+            assert len(ln["windows"]) >= 2
+            assert ln["h2h_intensity_flops_per_byte"] > 0
+            if ln["subphase"] == "train":
+                assert ln["bwd_h2h_intensity_flops_per_byte"] > 0
+        for ln in lines:
+            if "_pallas_" not in ln["metric"]:
+                continue
+            assert ln["vs_baseline"] is not None
+            assert len(ln["ratio_windows"]) == len(ln["windows"])
+            # the residency algebra: persistent intensity = blocked × T'
+            blocked = next(
+                b for b in lines
+                if b["hidden"] == ln["hidden"]
+                and b["subphase"] == ln["subphase"]
+                and "_blocked_" in b["metric"])
+            assert (ln["h2h_intensity_flops_per_byte"]
+                    > blocked["h2h_intensity_flops_per_byte"])
+        for h in hiddens:
+            assert f"pallas_over_blocked_ratio_h{h}_train" \
+                in doc["headline"]
+            assert f"pallas_over_blocked_ratio_h{h}_fwd" \
+                in doc["headline"]
+
     def test_committed_bench_r09_banks_the_fused_ab(self):
         """The r09 artifact's own claims hold: both readings carry
         per-window values at equal geometry, exact fused/unfused
@@ -496,15 +556,24 @@ class TestProfileMfuRnnAb:
         assert set(report["engines"]) == {"blocked", "pallas"}
         for eng in report["engines"].values():
             assert eng["fwd_ms"] > 0 and eng["fwd_bwd_ms"] > 0
-            assert eng["engine_fallback"] is False   # CPU interpret
+            # ISSUE 13: fallback recorded per engine PER PASS — a
+            # fallen-back backward must not bank a scan-vs-scan reading
+            assert eng["engine_fallback"] == {
+                "fwd": False, "fwd_bwd": False}   # CPU interpret
         h2h = report["h2h"]
         # the roofline algebra the ceiling doc reasons in: persistent
         # intensity = blocked intensity x T (weights read once per
-        # sequence instead of once per step)
+        # sequence instead of once per step) — for BOTH passes, the r10
+        # transposed backward included
         assert (h2h["intensity_persistent_flops_per_byte"]
                 == pytest.approx(
                     h2h["intensity_blocked_flops_per_byte"] * 8))
+        assert (h2h["bwd_intensity_persistent_flops_per_byte"]
+                == pytest.approx(
+                    h2h["bwd_intensity_blocked_flops_per_byte"] * 8))
+        assert h2h["bwd_flops_per_step"] == 2 * h2h["flops_per_step"]
         assert h2h["v5e_ridge_flops_per_byte"] == 240
+        assert report["run_metadata"]["tool"] == "profile_mfu_rnn_ab"
 
 
 class TestBenchScalingDrill:

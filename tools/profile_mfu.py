@@ -151,35 +151,53 @@ def main(argv=None) -> int:
                                "iters": args.iters},
                   "engines": {}}
         params = None
-        # analytic h2h terms (vanilla k=1): 2·B·H² FLOPs per step
-        # against the H²·db weight block
+        # analytic h2h terms (vanilla k=1): the forward recurrence does
+        # 2·B·H² FLOPs per step against the H²·db weight block; the
+        # TRANSPOSED backward does 2× that per step (dh ← dgate·Wᵀ plus
+        # the fused dW += hᵀ·dgate accumulation)
         h2h_fwd_flops = 2.0 * B * T * H * H
+        h2h_bwd_flops = 2.0 * h2h_fwd_flops
         for engine in ("blocked", "pallas"):
             net = Recurrent(cell=RnnCell(hidden_size=H), engine=engine)
+            # the fwd-only program prices only the forward's VMEM
+            # residency (pallas_grad=False): a backward-only budget
+            # overflow must fall back the fwd_bwd timing alone, not
+            # drag the forward reading down to blocked-vs-blocked
+            net_fwd = net.clone(pallas_grad=False)
             if params is None:
                 params = net.init(jax.random.PRNGKey(0), x)
 
             def loss(v, net=net):
                 return jnp.sum(net.apply(v, x, n_frames=n) ** 2)
 
-            jf = jax.jit(loss)
+            jf = jax.jit(lambda v, net=net_fwd:
+                         jnp.sum(net.apply(v, x, n_frames=n) ** 2))
             jg = jax.jit(jax.grad(loss))
             # the pallas engine warns + runs the blocked scan when the
             # geometry cannot be VMEM-resident (possible on TPU at
-            # fp32/H=1760) — record it, or this artifact could bank a
-            # blocked-vs-blocked "A/B" (the trace happens inside the
-            # first timed call, so capture around the timing)
+            # fp32/H=1760 — and the BACKWARD budget term can overflow
+            # where the forward fits) — record it PER PASS, or this
+            # artifact could bank a blocked-vs-blocked "A/B" (the trace
+            # happens inside each program's first timed call, so capture
+            # around each timing separately)
             import warnings
 
-            with warnings.catch_warnings(record=True) as caught:
+            with warnings.catch_warnings(record=True) as caught_f:
                 warnings.simplefilter("always")
                 t_f = timed(jf, params, iters=args.iters)
+            with warnings.catch_warnings(record=True) as caught_g:
+                warnings.simplefilter("always")
                 t_g = timed(jg, params, iters=args.iters)
             f_f, by_f = cost_of(jf, params)
             f_g, by_g = cost_of(jg, params)
+            bwd_only = (f_g - f_f) if (f_g and f_f) else 0.0
             report["engines"][engine] = {
-                "engine_fallback": any(
-                    "falling back" in str(w.message) for w in caught),
+                "engine_fallback": {
+                    "fwd": any("falling back" in str(w.message)
+                               for w in caught_f),
+                    "fwd_bwd": any("falling back" in str(w.message)
+                                   for w in caught_g),
+                },
                 "fwd_ms": round(t_f * 1e3, 2),
                 "fwd_bwd_ms": round(t_g * 1e3, 2),
                 "fwd_gflops": round(f_f / 1e9, 3) if f_f else None,
@@ -192,6 +210,9 @@ def main(argv=None) -> int:
                     round(f_g / by_g, 1) if by_g else None),
                 "h2h_share_of_fwd_flops": (
                     round(h2h_fwd_flops / f_f, 3) if f_f else None),
+                "h2h_share_of_bwd_flops": (
+                    round(h2h_bwd_flops / bwd_only, 3)
+                    if bwd_only > 0 else None),
             }
         eng = report["engines"]
         report["speedup_pallas_vs_blocked"] = {
@@ -206,17 +227,43 @@ def main(argv=None) -> int:
             "intensity_blocked_flops_per_byte": round(2.0 * B / db, 2),
             "intensity_persistent_flops_per_byte": round(
                 2.0 * B * T / db, 1),
+            # backward: 4·B·H² FLOPs per step (dh chain + dW accum)
+            # against 2·H²·db weight bytes (W and Wᵀ) — restreamed per
+            # step under the scan vjp, read once per sequence by the
+            # transposed persistent kernel: the RATIO is the forward's
+            "bwd_flops_per_step": 4.0 * B * H * H,
+            "bwd_intensity_blocked_flops_per_byte": round(
+                2.0 * B / db, 2),
+            "bwd_intensity_persistent_flops_per_byte": round(
+                2.0 * B * T / db, 1),
+            # within the ANALYTIC backward matmul decomposition
+            # (h2h: dh 2BTH² + dW_h2h 2BTH²; i2h: dW_i2h 2BTH² for the
+            # vanilla D=H cell) — the basis-robust share
+            "bwd_h2h_share_of_analytic_matmul_flops": round(4 / 6, 3),
             "v5e_ridge_flops_per_byte": 240,
         }
         report["note"] = (
             "h2h_share_of_fwd_flops = analytic 2·B·T·H² over XLA's "
-            "compiled FLOP count; intensity_* is the h2h term's "
-            "FLOP/byte under each weight-streaming discipline (blocked "
-            "re-reads the H²·dtype_bytes block every step, persistent "
-            "reads it once per sequence).  On a CPU backend the pallas "
+            "compiled FLOP count; h2h_share_of_bwd_flops = analytic "
+            "4·B·T·H² (dh ← dgate·Wᵀ plus dW += hᵀ·dgate) over the "
+            "bwd-only FLOPs (fwd_bwd − fwd) — NOTE this counted basis "
+            "can read >1 on the CPU backend, whose cost analysis "
+            "under-counts transposed contractions; recorded honestly "
+            "rather than clipped, with h2h.bwd_h2h_share_of_analytic_"
+            "matmul_flops (2/3) as the basis-robust companion; "
+            "intensity_* is the h2h "
+            "term's FLOP/byte under each weight-streaming discipline "
+            "(blocked/scan-vjp re-reads the weight block every step, "
+            "the persistent kernels — forward AND the r10 transposed "
+            "backward — read it once per sequence).  engine_fallback "
+            "is recorded per pass: a fallen-back backward must not "
+            "bank a scan-vs-scan reading.  On a CPU backend the pallas "
             "engine runs interpret-mode (discharged to XLA): timings "
             "then bank schedule parity/overhead only — the HBM "
             "residency term pays on a real TPU.")
+        from analytics_zoo_tpu.obs import run_metadata
+
+        report["run_metadata"] = run_metadata("profile_mfu_rnn_ab", seed=0)
         print(json.dumps(report, indent=2))
         with open(args.out, "w") as f:
             json.dump(report, f, indent=2)
