@@ -390,6 +390,63 @@ def _ds2_serving(mesh) -> List[AuditProgram]:
     return _tier_targets("ds2", tiers, specs)
 
 
+def _ds2_streaming_serving(mesh) -> List[AuditProgram]:
+    # the ISSUE-14 first-class streaming session model: audit the
+    # steady-block carry-in/carry-out program every chunk dispatches
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.models import DeepSpeech2
+    from analytics_zoo_tpu.parallel import pipeline_specs
+    from analytics_zoo_tpu.pipelines.deepspeech2 import ds2_streaming_tiers
+
+    module = DeepSpeech2(hidden=16, n_rnn_layers=1, n_mels=13,
+                         bidirectional=False)
+    model = Model(module)
+    model.variables = abstract_variables(module,
+                                         _S((1, 64, 13), np.float32))
+    specs = pipeline_specs("ds2", mesh=mesh)
+    tiers = ds2_streaming_tiers(model, n_mels=13, chunk_frames=50)
+    return _tier_targets("ds2-stream", tiers, specs)
+
+
+def _frcnn_serving(mesh) -> List[AuditProgram]:
+    from analytics_zoo_tpu.models import FasterRcnnDetector, FrcnnParam
+    from analytics_zoo_tpu.ops.proposal import ProposalParam
+    from analytics_zoo_tpu.parallel import pipeline_specs
+    from analytics_zoo_tpu.pipelines.frcnn import frcnn_serving_tiers
+    from analytics_zoo_tpu.pipelines.ssd import PreProcessParam
+
+    RES, NCLS = 128, 4
+    detector = FasterRcnnDetector(param=FrcnnParam(
+        num_classes=NCLS,
+        proposal=ProposalParam(pre_nms_topn=64, post_nms_topn=16)))
+    # int8 quantization reads weight values for its scales → filled
+    variables = filled(abstract_variables(
+        detector, _S((1, RES, RES, 3), np.float32),
+        _S((1, 3), np.float32)))
+    specs = pipeline_specs("frcnn", mesh=mesh)
+    tiers = frcnn_serving_tiers(
+        detector, variables,
+        param=PreProcessParam(batch_size=specs.data_axis_size,
+                              resolution=RES),
+        specs=specs)
+    return _tier_targets("frcnn", tiers, specs)
+
+
+def _fraud_serving(mesh) -> List[AuditProgram]:
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.models import FraudMLP
+    from analytics_zoo_tpu.parallel import pipeline_specs
+    from analytics_zoo_tpu.pipelines.fraud import fraud_serving_tiers
+
+    module = FraudMLP(in_features=29, hidden=10, n_classes=2)
+    model = Model(module)
+    model.variables = filled(abstract_variables(
+        module, _S((1, 29), np.float32)))
+    specs = pipeline_specs("fraud", mesh=mesh)
+    tiers = fraud_serving_tiers(model, specs=specs)
+    return _tier_targets("fraud", tiers, specs)
+
+
 def _guarded_tiers(kind: str, builder, mesh) -> List[AuditProgram]:
     """The serving-tier targets need the tier FACTORIES to run before
     the target names are even known (names come from the rungs).  A
@@ -421,4 +478,9 @@ def repo_audit_suite(mesh=None) -> List[AuditProgram]:
     targets += _fraud(mesh)
     targets += _guarded_tiers("ssd", _ssd_serving, mesh)
     targets += _guarded_tiers("ds2", _ds2_serving, mesh)
+    # the ISSUE-14 multiplexed fleet: every model family the shared
+    # replica pool schedules exposes its serving programs to the audit
+    targets += _guarded_tiers("ds2-stream", _ds2_streaming_serving, mesh)
+    targets += _guarded_tiers("frcnn", _frcnn_serving, mesh)
+    targets += _guarded_tiers("fraud", _fraud_serving, mesh)
     return targets

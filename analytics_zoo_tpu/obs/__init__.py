@@ -55,6 +55,8 @@ from analytics_zoo_tpu.obs.runmeta import run_metadata
 from analytics_zoo_tpu.obs.slo import (SLO, SloDecision, SloEvaluator,
                                        deadline_miss_slo,
                                        default_serving_slos,
+                                       model_deadline_miss_slo,
+                                       model_shed_rate_slo, model_slos,
                                        p99_latency_slo, shed_rate_slo)
 from analytics_zoo_tpu.obs.span import Span, Tracer, span_conservation
 from analytics_zoo_tpu.obs.trace import (SEGMENTS, TraceStore,
@@ -125,6 +127,9 @@ __all__ = [
     "attribution_rows",
     "deadline_miss_slo",
     "default_serving_slos",
+    "model_deadline_miss_slo",
+    "model_shed_rate_slo",
+    "model_slos",
     "dump_flight_jsonl",
     "format_critical_path",
     "p99_latency_slo",

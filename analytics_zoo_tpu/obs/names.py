@@ -52,6 +52,39 @@ CATALOG: Dict[str, str] = {
         "histogram · dispatched-batch fill fraction (n_valid/max_batch)",
     "serve/queue_depth":
         "histogram · admission-queue depth sampled at each dispatch",
+    # -- multiplexed fleet (ServingRuntime(models=...), ISSUE 14) -----------
+    "serve/submitted/model=*":
+        "counter · requests submitted per multiplexed model",
+    "serve/completed/model=*":
+        "counter · requests completed per multiplexed model",
+    "serve/failed/model=*":
+        "counter · requests failed per multiplexed model",
+    "serve/shed/model=*":
+        "counter · requests shed per multiplexed model, by cause "
+        "(model= then cause= labels)",
+    "serve/deadline_misses_completed_late/model=*":
+        "counter · completed-late requests per multiplexed model",
+    "serve/latency_s/model=*":
+        "histogram · end-to-end request latency per (model, tier)",
+    "serve/model_weight/model=*":
+        "gauge · weighted-EDF dispatch weight per model (1 = plain EDF; "
+        "follows the model's worst fast-window SLO burn)",
+    "serve/sessions/opened":
+        "counter · streaming sessions opened (session-affine scheduling)",
+    "serve/sessions/closed":
+        "counter · streaming sessions closed (final chunk or state loss)",
+    "serve/sessions_open":
+        "gauge · streaming sessions currently open",
+    "serve/cold_compiles":
+        "counter · dispatches that paid the cold-compile tax (a replica "
+        "served a geometry it had never compiled — what pre-warm deletes)",
+    # -- autoscaler (serving.autoscale.Autoscaler) --------------------------
+    "autoscale/replicas":
+        "gauge · current (or just-actuated target) replica-pool size",
+    "autoscale/grow":
+        "counter · pool-growth actuations taken by the policy loop",
+    "autoscale/shrink":
+        "counter · drain-then-retire shrink actuations taken",
     # -- SLO engine (obs.slo.SloEvaluator(registry=)) -----------------------
     "slo/fast_burn/slo=*":
         "gauge · latest fast-window burn rate per SLO (1.0 = budget "

@@ -142,6 +142,45 @@ def default_serving_slos() -> List[SLO]:
             p99_latency_slo(0.5)]
 
 
+def model_deadline_miss_slo(model: str, budget: float = 0.2) -> SLO:
+    """Per-model deadline-miss rate ≤ ``budget`` over ONE multiplexed
+    model's terminal requests (the model-labeled counters
+    ``ServingRuntime(models=...)`` maintains) — the per-model SLO whose
+    burn rate drives that model's ladder and weighted-EDF weight."""
+    return SLO(
+        name=f"deadline-miss-rate/model={model}", kind="ratio",
+        budget=budget,
+        bad=(f"serve/deadline_misses_completed_late/model={model}",
+             f"serve/failed/model={model}",
+             f"serve/shed/model={model}/cause=*"),
+        total=(f"serve/completed/model={model}",
+               f"serve/failed/model={model}",
+               f"serve/shed/model={model}/cause=*"),
+        description=f"fraction of {model} terminal requests that missed "
+                    f"their deadline (shed | failed | completed late)")
+
+
+def model_shed_rate_slo(model: str, budget: float = 0.1) -> SLO:
+    """Per-model shed fraction of submitted requests ≤ ``budget``."""
+    return SLO(
+        name=f"shed-rate/model={model}", kind="ratio", budget=budget,
+        bad=(f"serve/shed/model={model}/cause=*",),
+        total=(f"serve/submitted/model={model}",),
+        description=f"fraction of submitted {model} requests shed "
+                    f"before device dispatch")
+
+
+def model_slos(model: str, miss_budget: float = 0.2,
+               shed_budget: float = 0.15) -> List[SLO]:
+    """The per-model objective pair a multiplexed
+    ``ServingRuntime(models=[ModelConfig(slos=model_slos(name))])``
+    declares per family: miss rate + shed rate over the model-labeled
+    counters.  SLO names embed ``model=`` so the mirrored ``slo/*``
+    gauges carry the model as a label."""
+    return [model_deadline_miss_slo(model, miss_budget),
+            model_shed_rate_slo(model, shed_budget)]
+
+
 def _match_sum(counters: Dict[str, Any],
                patterns: Sequence[str]) -> float:
     total = 0.0

@@ -44,11 +44,16 @@ from analytics_zoo_tpu.pipelines.ssd import (
     train_transformer,
     val_transformer,
 )
-from analytics_zoo_tpu.pipelines.frcnn import FRCNN_BGR_MEANS, FrcnnPredictor
+from analytics_zoo_tpu.pipelines.frcnn import (
+    FRCNN_BGR_MEANS,
+    FrcnnPredictor,
+    frcnn_serving_tiers,
+)
 from analytics_zoo_tpu.pipelines.fraud import (
     FraudResult,
     MLPClassifier,
     auprc,
+    fraud_serving_tiers,
     precision_recall,
     run_fraud_pipeline,
 )
@@ -56,6 +61,8 @@ from analytics_zoo_tpu.pipelines.visualizer import result_to_string, vis_detecti
 from analytics_zoo_tpu.pipelines.deepspeech2 import (
     DS2Param,
     DeepSpeech2Pipeline,
+    ds2_serving_tiers,
+    ds2_streaming_tiers,
     make_ds2_model,
 )
 

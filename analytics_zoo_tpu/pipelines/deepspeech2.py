@@ -800,3 +800,88 @@ def ds2_serving_tiers(model: Model, param: Optional[DS2Param] = None,
                                  "cheapest rung",
                     device_program=audit_program),
     ]
+
+
+def ds2_streaming_tiers(model: Model, n_mels: int = 13,
+                        chunk_frames: int = 100) -> List:
+    """ONE replica's tier instances for the first-class streaming ASR
+    session model (ISSUE 14): a stateful forward owning this replica's
+    session store — ``{session id: StreamingDS2}`` — so session-affine
+    scheduling is physically meaningful (the carry state LIVES on the
+    pinned replica; a migrated session would decode from zeroed state,
+    which is exactly why the pool never fails a session batch over).
+
+    Batch contract (what a ``ModelConfig(streaming=True)`` plan
+    assembles): ``{"input": (B, edge) float32 raw samples, "n_samples":
+    (B,) true lengths, "session": (B,) int64 ids (−1 padding),
+    "final": (B,) int8 flush flags}``.  Each row routes to its
+    session's :class:`StreamingDS2` — featurization residue, conv
+    context, RNN hidden state and CTC collapse state all carry across
+    chunks, so the concatenated pieces exactly equal the whole-
+    utterance forward (the ``StreamingDS2`` exactness contract, now
+    riding the multiplexed runtime).  A ``final`` row appends the
+    stream's :meth:`StreamingDS2.flush` tail and retires the session's
+    state.
+
+    Use with ``functools.partial`` as the per-replica factory::
+
+        ModelConfig(name="ds2-stream", streaming=True,
+                    tiers=ds2_streaming_tiers(model),
+                    tier_factory=lambda rid: ds2_streaming_tiers(model),
+                    pad_key="input", length_key="n_samples",
+                    bucket_edges=[...sample-count edges...])
+    """
+    from analytics_zoo_tpu.serving.ladder import ServingTier
+
+    store: Dict[int, StreamingDS2] = {}
+
+    def forward(batch: Dict) -> List[str]:
+        sessions = batch["session"]
+        final = batch["final"]
+        lens = batch.get("n_samples")
+        texts: List[str] = []
+        for i in range(len(sessions)):
+            sid = int(sessions[i])
+            if sid < 0:             # batch-axis padding row
+                texts.append("")
+                continue
+            stream = store.get(sid)
+            if stream is None:
+                stream = StreamingDS2(model, n_mels=n_mels,
+                                      chunk_frames=chunk_frames)
+                store[sid] = stream
+            n = (int(lens[i]) if lens is not None
+                 else batch["input"].shape[1])
+            piece = (stream.accept(np.asarray(batch["input"][i][:n],
+                                              np.float32))
+                     if n > 0 else "")
+            if int(final[i]):
+                piece += stream.flush()
+                store.pop(sid, None)
+            texts.append(piece)
+        return texts
+
+    def device_program():
+        """``az_analyze --program`` hook: the steady-block jitted apply
+        every chunk dispatches (carry in, carry out)."""
+        hidden = model.module.hidden
+        layers = model.module.n_rnn_layers
+        S = jax.ShapeDtypeStruct
+        carry = {"h": tuple(S((1, hidden), jnp.float32)
+                            for _ in range(layers))}
+        fn = jax.jit(lambda v, x, c: model.module.apply(
+            v, x, carry=c, return_carry=True))
+        ext = chunk_frames + StreamingDS2._CTX
+        return (fn, (model.variables,
+                     S((1, ext, n_mels), jnp.float32), carry), ())
+
+    return [ServingTier(
+        "stream", forward, speed=1.0,
+        quality_note=f"stateful streaming session ({chunk_frames}-frame "
+                     f"blocks, exact to the whole-utterance forward)",
+        device_program=device_program,
+        # the runtime evicts a killed session's carry here, so failed
+        # sessions don't leak StreamingDS2 state on the replica (its
+        # still-queued chunks are failed before dispatch, so the entry
+        # is never recreated)
+        evict_session=lambda sid: store.pop(sid, None))]

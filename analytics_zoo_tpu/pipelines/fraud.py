@@ -91,6 +91,60 @@ class MLPClassifier(Stage):
         return out
 
 
+def fraud_serving_tiers(model: Model, specs=None):
+    """Degradation-ladder rungs for the online serving runtime
+    (ISSUE 14 — fraud joins the multiplexed fleet): two
+    :class:`~analytics_zoo_tpu.serving.ladder.ServingTier` s over the
+    trained ``FraudMLP``, cheapest last.
+
+    Requests carry one assembled+scaled feature row (``{"input":
+    (in_features,) float32}`` — the frame pipeline's ``features``
+    column; fixed shape, the serving batcher's FIXED bucket).  Tier 0
+    serves full-precision weights through the (optionally mesh-
+    annotated) eval step; tier 1 serves weight-only int8 via the same
+    ``quantize_params`` mechanism as the SSD ladder.  Both rungs
+    expose their jitted program to the az-analyze serving audit
+    (``fraud/serve:*`` targets).
+    """
+    from analytics_zoo_tpu.parallel import make_eval_step
+    from analytics_zoo_tpu.serving.ladder import ServingTier
+    from analytics_zoo_tpu.utils.quantize import (make_quantized_forward,
+                                                  quantize_params)
+
+    in_features = model.module.in_features
+    eval_step = make_eval_step(model.module, specs=specs)
+    qparams = quantize_params(model.variables)
+    qfwd = make_quantized_forward(model.module)
+
+    def fwd_fp(batch: Dict) -> np.ndarray:
+        return np.asarray(eval_step(model.variables,
+                                    jnp.asarray(batch["input"])))
+
+    def fwd_int8(batch: Dict) -> np.ndarray:
+        return np.asarray(qfwd(qparams, jnp.asarray(batch["input"])))
+
+    B = specs.data_axis_size if specs is not None else 1
+
+    def audit_fp():
+        return (eval_step,
+                (model.variables,
+                 jax.ShapeDtypeStruct((B, in_features), jnp.float32)), ())
+
+    def audit_int8():
+        return (qfwd,
+                (qparams,
+                 jax.ShapeDtypeStruct((B, in_features), jnp.float32)), ())
+
+    return [
+        ServingTier("fp", fwd_fp, speed=1.0,
+                    quality_note="fp32 weights, annotated eval step",
+                    device_program=audit_fp),
+        ServingTier("int8", fwd_int8, speed=0.8,
+                    quality_note="weight-only int8 (quantize_params)",
+                    device_program=audit_int8),
+    ]
+
+
 def auprc(labels: np.ndarray, scores: np.ndarray) -> float:
     """Area under the precision-recall curve (the reference evaluates with
     ``BinaryClassificationEvaluator`` AUPRC, ``BigDLKaggleFraud.scala:60``)."""

@@ -145,6 +145,63 @@ class FrcnnPredictor:
             self._detect_device, lambda t: self._rescale(*t))
 
 
+def frcnn_serving_tiers(detector: FasterRcnnDetector, variables,
+                        param: Optional[PreProcessParam] = None,
+                        specs=None, aspect_preserving: bool = True) -> List:
+    """Degradation-ladder rungs for the online serving runtime
+    (ISSUE 14 — Faster-RCNN joins the multiplexed fleet): two
+    :class:`~analytics_zoo_tpu.serving.ladder.ServingTier` s over the
+    SAME in-graph post-processing forward, cheapest last — tier 0 full
+    precision, tier 1 weight-only int8 via the ``FrcnnPredictor(
+    quantize=True)`` path (dequant fused into the consuming convs).
+
+    Requests carry one preprocessed fixed-canvas image (``{"input":
+    (res, res, 3) float32}``, pixel means already subtracted — the
+    serving batcher's FIXED bucket, same convention as the SSD tiers);
+    the forward synthesizes the unit-scale ``im_info`` for the full
+    canvas, so detections come back in canvas pixels.  Each rung's
+    ``device_program`` thunk exposes the jitted detector program to the
+    az-analyze serving audit (``frcnn/serve:*`` targets).
+    """
+    from analytics_zoo_tpu.serving.ladder import ServingTier
+
+    full = FrcnnPredictor(detector, variables, param=param,
+                          aspect_preserving=aspect_preserving)
+    int8 = FrcnnPredictor(detector, variables, param=full.param,
+                          swap_default_means=False, quantize=True)
+    res = full.param.resolution
+
+    def fwd(pred: FrcnnPredictor):
+        def forward(batch: Dict) -> np.ndarray:
+            B = batch["input"].shape[0]
+            # fixed serving canvas at unit scale: content fills the
+            # square, boxes come back in canvas pixels
+            im_info = np.tile(
+                np.asarray([[res, res, 1.0, 1.0]], np.float32), (B, 1))
+            return pred.detect_batch({"input": batch["input"],
+                                      "im_info": im_info})
+        return forward
+
+    def audit(pred: FrcnnPredictor):
+        def device_program():
+            B = specs.data_axis_size if specs is not None else 1
+            S = jax.ShapeDtypeStruct
+            return (pred._fwd,
+                    (pred.variables, S((B, res, res, 3), jnp.float32),
+                     S((B, 3), jnp.float32)), ())
+        return device_program
+
+    return [
+        ServingTier("fp", fwd(full), speed=1.0,
+                    quality_note="full precision, in-graph NMS",
+                    device_program=audit(full)),
+        ServingTier("int8", fwd(int8), speed=0.77,
+                    quality_note="weight-only int8 (dequant fused into "
+                                 "the consuming convs)",
+                    device_program=audit(int8)),
+    ]
+
+
 def frcnn_train_batches(dataset, resolution: int):
     """Adapt SSD-style labeled batches (normalized gt) to the Faster-RCNN
     train step's input contract: ``input`` becomes the forward tuple

@@ -24,23 +24,32 @@ predictable-latency discipline (Gujarati et al., OSDI'20):
   top-K tier steps with promote-style hysteresis;
 - :mod:`metrics` — :class:`ServingMetrics` snapshot dict;
 - :mod:`runtime` — :class:`ServingRuntime`, the synchronous clock-driven
-  scheduler gluing them together.
+  scheduler gluing them together; ``models=[ModelConfig(...)]`` turns
+  it into the fleet control plane (ISSUE 14): multi-model multiplexing
+  with per-model SLOs/ladders/EWMAs, weighted-EDF admission, and
+  session-affine streaming scheduling;
+- :mod:`autoscale` — :class:`Autoscaler`: the closed policy loop that
+  turns SLO burn rates into ``ReplicaPool.resize`` actuations, growth
+  pre-warmed so a scale-up never serves a cold jit cache.
 
 Drill: ``python tools/serve_drill.py`` (committed artifact
 ``RESILIENCE_r03.json``).  Docs: docs/SERVING.md "Operating under
 load"; failure semantics in docs/RESILIENCE.md.
 """
 
+from analytics_zoo_tpu.serving.autoscale import (Autoscaler,
+                                                 AutoscalePolicy)
 from analytics_zoo_tpu.serving.batcher import (FIXED, AssembledBatch,
-                                               DeadlineBatcher)
+                                               DeadlineBatcher, ModelPlan)
 from analytics_zoo_tpu.serving.clock import (Clock, MonotonicClock,
                                              VirtualClock)
 from analytics_zoo_tpu.serving.ladder import (DegradationLadder,
                                               LadderPolicy, ServingTier)
 from analytics_zoo_tpu.serving.metrics import ServingMetrics, percentile
 from analytics_zoo_tpu.serving.replica import Replica, ReplicaPool
-from analytics_zoo_tpu.serving.request import (TERMINAL_STATES,
+from analytics_zoo_tpu.serving.request import (DEFAULT_MODEL,
+                                               TERMINAL_STATES,
                                                AdmissionQueue, Request)
-from analytics_zoo_tpu.serving.runtime import ServingRuntime
+from analytics_zoo_tpu.serving.runtime import ModelConfig, ServingRuntime
 
 __all__ = [k for k in dir() if not k.startswith("_")]

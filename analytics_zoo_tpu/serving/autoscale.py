@@ -1,0 +1,202 @@
+"""Closed-loop autoscaling: the SLO burn-rate signal finally actuates.
+
+PR 11 built the decision input — :class:`~analytics_zoo_tpu.obs.slo.
+SloEvaluator` turns registry-snapshot windows into multi-window burn
+rates and ``SloDecision.scale_hint`` (+1/0/−1) — and mirrored the burns
+into ``slo/*`` gauges precisely so an autoscaler could consume them.
+Nothing did.  This module is the actuator half of ROADMAP item 1:
+
+- :class:`AutoscalePolicy` — the knobs: pool bounds, how many
+  consecutive burning decisions trigger growth, how many consecutive
+  well-under-budget decisions (``scale_hint == −1``) trigger a shrink,
+  and a post-actuation cooldown.  The asymmetry deliberately mirrors
+  the :class:`~analytics_zoo_tpu.serving.ladder.DegradationLadder`
+  hysteresis: growing is cheap and urgent (capacity arrives warm via
+  pre-warm), shrinking into still-marginal load re-creates the burn and
+  flaps, so the shrink streak is long and any non-shrink hint resets
+  it.
+
+- :class:`Autoscaler` — the pure policy loop: feed it each decision
+  window's :class:`~analytics_zoo_tpu.obs.slo.SloDecision` (what
+  ``ServingRuntime`` does) or a raw registry snapshot's ``slo/*``
+  gauges (:meth:`observe_registry` — the snapshot-only consumer the
+  PR-11 mirroring promised), get back the target pool size when an
+  actuation is due.  The RUNTIME executes the action through
+  :meth:`~analytics_zoo_tpu.serving.replica.ReplicaPool.resize` —
+  growth pre-warms compiled geometries before the replica joins
+  dispatch, shrink drains-then-retires — so the policy here stays
+  testable on hand-fed decision streams with no pool at all.
+
+Semantics of the multi-window hint (``obs/slo.py``): ``+1`` only while
+an SLO burns on BOTH windows (fast reacts, slow confirms) — a fast-
+window-only spike holds rather than grows, exactly the blip the SRE
+multi-window discipline exists to ignore; ``−1`` only when every SLO is
+far under budget on both windows.  The policy adds streaks + cooldown
+on top so a single noisy decision never bounces the pool.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+#: gauge-name prefix the snapshot-only observer reads (the PR-11
+#: mirror: ``slo/fast_burn/slo=<name>`` / ``slo/slow_burn/slo=<name>``)
+_FAST_PREFIX = "slo/fast_burn/slo="
+_SLOW_PREFIX = "slo/slow_burn/slo="
+
+
+@dataclasses.dataclass
+class AutoscalePolicy:
+    """Bounds + hysteresis for the policy loop.
+
+    ``grow_after`` consecutive burning decisions (``scale_hint == +1``)
+    → grow by ``step``; ``shrink_after`` consecutive clean-and-idle
+    decisions (``scale_hint == −1``) → shrink by ``step``;
+    ``cooldown`` decisions after any actuation ignore the streaks (the
+    new capacity needs a window to move the burn rates before the loop
+    reacts again).  ``prewarm``: whether growth pre-warms compiled
+    geometries before joining dispatch (the drill's A/B knob).
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    grow_after: int = 1
+    shrink_after: int = 6
+    cooldown: int = 2
+    step: int = 1
+    prewarm: bool = True
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        if self.grow_after < 1 or self.shrink_after < 1 or self.step < 1:
+            raise ValueError("grow_after/shrink_after/step must be >= 1")
+        if self.cooldown < 0:
+            raise ValueError("cooldown must be >= 0")
+
+
+class Autoscaler:
+    """The policy loop: decisions in, target pool sizes out.
+
+    ``registry`` (optional): actuations and the current/target sizes
+    are mirrored into it (``autoscale/*`` — see ``obs/names.py``) so a
+    scrape shows what the loop did and why-shaped counters
+    (grow/shrink/hold) accumulate.  ``events`` is the deterministic
+    action log the drill banks.
+    """
+
+    def __init__(self, policy: Optional[AutoscalePolicy] = None,
+                 registry=None):
+        self.policy = policy or AutoscalePolicy()
+        self.registry = registry
+        self.grow_streak = 0
+        self.shrink_streak = 0
+        self.cooldown_left = 0
+        self.decisions = 0
+        self.grows = 0
+        self.shrinks = 0
+        self.events: List[Dict[str, Any]] = []
+
+    # -- feed ----------------------------------------------------------------
+    def observe_decision(self, decision, current_size: int,
+                         t: Optional[float] = None) -> Optional[int]:
+        """Feed one :class:`~analytics_zoo_tpu.obs.slo.SloDecision`;
+        returns the new TARGET pool size when an actuation is due,
+        else ``None`` (hold)."""
+        return self.observe_hint(decision.scale_hint, current_size,
+                                 t=decision.t if t is None else t,
+                                 burning=list(decision.burning))
+
+    def observe_registry(self, snapshot: Dict[str, Any],
+                         current_size: int,
+                         t: float,
+                         fast_burn: float = 2.0, slow_burn: float = 1.0,
+                         recover_burn: float = 0.5) -> Optional[int]:
+        """Snapshot-only path: reconstruct the hint from the mirrored
+        ``slo/*_burn`` gauges of one ``MetricRegistry.snapshot()`` —
+        the consumer shape PR 11 promised (no evaluator object needed).
+        Burning = fast ≥ ``fast_burn`` AND slow ≥ ``slow_burn`` per
+        SLO; idle = every burn ≤ ``recover_burn`` on both windows."""
+        gauges = snapshot.get("gauges", {})
+        fast = {k[len(_FAST_PREFIX):]: float(v)
+                for k, v in gauges.items() if k.startswith(_FAST_PREFIX)}
+        slow = {k[len(_SLOW_PREFIX):]: float(v)
+                for k, v in gauges.items() if k.startswith(_SLOW_PREFIX)}
+        burning = [name for name in fast
+                   if fast[name] >= fast_burn
+                   and slow.get(name, 0.0) >= slow_burn]
+        if burning:
+            hint = 1
+        elif fast and all(v <= recover_burn for v in fast.values()) \
+                and all(v <= recover_burn for v in slow.values()):
+            hint = -1
+        else:
+            hint = 0
+        return self.observe_hint(hint, current_size, t=t, burning=burning)
+
+    def observe_hint(self, hint: int, current_size: int, t: float = 0.0,
+                     burning: Optional[List[str]] = None) -> Optional[int]:
+        """The core loop on a bare ``scale_hint``.  Streak discipline:
+        +1 grows the grow streak and kills the shrink streak; −1 the
+        inverse; 0 (a fast-only spike, or mixed signals) kills BOTH —
+        holding is the correct response to an unconfirmed burn."""
+        self.decisions += 1
+        p = self.policy
+        if self.cooldown_left > 0:
+            self.cooldown_left -= 1
+            self._export(current_size)
+            return None
+        if hint > 0:
+            self.shrink_streak = 0
+            self.grow_streak += 1
+        elif hint < 0:
+            self.grow_streak = 0
+            self.shrink_streak += 1
+        else:
+            self.grow_streak = 0
+            self.shrink_streak = 0
+        target: Optional[int] = None
+        action = None
+        if self.grow_streak >= p.grow_after \
+                and current_size < p.max_replicas:
+            target = min(current_size + p.step, p.max_replicas)
+            action = "grow"
+            self.grows += 1
+        elif self.shrink_streak >= p.shrink_after \
+                and current_size > p.min_replicas:
+            target = max(current_size - p.step, p.min_replicas)
+            action = "shrink"
+            self.shrinks += 1
+        if target is not None:
+            self.grow_streak = 0
+            self.shrink_streak = 0
+            self.cooldown_left = p.cooldown
+            self.events.append({
+                "kind": f"scale_{action}", "t": round(t, 6),
+                "from": current_size, "to": target,
+                "burning": list(burning or []),
+                "prewarm": p.prewarm})
+            if self.registry is not None:
+                if action == "grow":
+                    self.registry.counter("autoscale/grow").inc()
+                else:
+                    self.registry.counter("autoscale/shrink").inc()
+        self._export(current_size if target is None else target)
+        return target
+
+    def _export(self, size: int) -> None:
+        if self.registry is not None:
+            self.registry.gauge("autoscale/replicas").set(float(size))
+
+    # -- read ----------------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "policy": dataclasses.asdict(self.policy),
+            "decisions": self.decisions,
+            "grows": self.grows,
+            "shrinks": self.shrinks,
+            "actions": list(self.events),
+        }

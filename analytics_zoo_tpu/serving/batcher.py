@@ -26,27 +26,64 @@ bucket satisfies ``deadline - now <= t_est + slack_margin`` — i.e. the
 urgent request would miss if we waited any longer.  Estimation comes
 from ``service_time(edge, n, tier)``, the same model the drill uses, or
 from an online EWMA of observed service times when none is given.
+
+Multiplexing (ISSUE 14, the Clipper frontend pattern): a batcher given
+``plans`` (one :class:`ModelPlan` per registered model) keeps a bucket
+per **(model, affinity, edge)** — models never share a batch, a
+streaming session's chunks only group with chunks pinned to the same
+replica — and the service-time EWMA keys per **(model, edge, tier)**
+with the PR-5 always-urgent cold seed *per key*, so one model's learned
+estimate never flushes (or starves) another model's batches.  Flush-
+ready buckets are drained in **weighted-EDF** order: the runtime feeds
+per-model weights from the SLO burn rates (``set_model_weight``) and a
+burning model's slack is divided by its weight, so its buckets win the
+next dispatch — deadline-weighted by how fast that model's error
+budget is being spent.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from analytics_zoo_tpu.data.bucket import edge_for
-from analytics_zoo_tpu.serving.request import AdmissionQueue, Request
+from analytics_zoo_tpu.serving.request import (DEFAULT_MODEL,
+                                               AdmissionQueue, Request)
 
 #: bucket key for fixed-shape models (no variable axis)
 FIXED = "fixed"
 
 
 @dataclasses.dataclass
+class ModelPlan:
+    """Per-model batching geometry for a multiplexed runtime.
+
+    ``bucket_edges``: variable-axis edges (``None`` = fixed shape);
+    ``pad_key``/``length_key``: the payload leaf padded to the edge and
+    the per-row valid-length vector's batch key; ``max_batch``: per-
+    model batch axis (``None`` = the batcher's global ``max_batch``);
+    ``streaming``: session-type model — assembled batches additionally
+    carry ``session`` (int64, padding rows −1) and ``final`` (int8)
+    vectors so the stateful forward can route each row to its session
+    carry and flush on the last chunk.
+    """
+
+    bucket_edges: Optional[Sequence[int]] = None
+    pad_key: str = "input"
+    length_key: Optional[str] = "n_frames"
+    max_batch: Optional[int] = None
+    streaming: bool = False
+
+
+@dataclasses.dataclass
 class AssembledBatch:
     """One device-ready batch: ``requests`` in EDF order, padded
     ``batch`` dict, the geometry it compiled under, and the dispatch
-    bookkeeping the failover path reads (``redispatched``)."""
+    bookkeeping the failover path reads (``redispatched``).  ``model``
+    keys the replica's per-model forward table; ``affinity`` (set for
+    session batches) pins the dispatch to one replica."""
 
     requests: List[Request]
     batch: Dict[str, Any]
@@ -54,6 +91,8 @@ class AssembledBatch:
     n_valid: int
     tier: int = 0
     redispatched: bool = False      # exactly-once failover latch
+    model: str = DEFAULT_MODEL
+    affinity: Optional[int] = None
 
     @property
     def earliest_deadline(self) -> float:
@@ -68,94 +107,182 @@ class DeadlineBatcher:
     as-is.  ``length_key`` (when set) adds the per-row valid-length
     vector to the batch — the same contract ``BucketBatcher`` gives the
     train step.
+
+    ``plans`` (multiplexed mode): model name → :class:`ModelPlan`; the
+    legacy ``bucket_edges``/``pad_key``/``length_key`` arguments then
+    only seed the ``DEFAULT_MODEL`` plan when none is declared.  With
+    plans, ``service_time`` takes ``(model, edge, n, tier)``; without,
+    the PR-5 ``(edge, n, tier)`` signature is unchanged.
     """
 
     def __init__(self, queue: AdmissionQueue, max_batch: int,
                  bucket_edges: Optional[Sequence[int]] = None,
                  pad_key: str = "input",
                  length_key: Optional[str] = "n_frames",
-                 service_time: Optional[
-                     Callable[[Any, int, int], float]] = None,
-                 slack_margin_s: float = 0.0):
+                 service_time: Optional[Callable[..., float]] = None,
+                 slack_margin_s: float = 0.0,
+                 plans: Optional[Dict[str, ModelPlan]] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.queue = queue
         self.max_batch = int(max_batch)
-        self.bucket_edges = (sorted(int(e) for e in bucket_edges)
-                             if bucket_edges else None)
-        self.pad_key = pad_key
-        self.length_key = length_key
+        self.multiplexed = plans is not None
+        if plans is None:
+            plans = {DEFAULT_MODEL: ModelPlan(
+                bucket_edges=bucket_edges, pad_key=pad_key,
+                length_key=length_key)}
+        self.plans: Dict[str, ModelPlan] = {}
+        for name, plan in plans.items():
+            edges = (sorted(int(e) for e in plan.bucket_edges)
+                     if plan.bucket_edges else None)
+            self.plans[name] = dataclasses.replace(plan, bucket_edges=edges)
         self.service_time = service_time
         self.slack_margin_s = float(slack_margin_s)
-        # online EWMA of observed per-(geometry, tier) service time, used
-        # when no explicit model is configured; a geometry with no
+        # online EWMA of observed per-(model, geometry, tier) service
+        # time, used when no explicit model is configured; a key with no
         # observation yet estimates +inf ⇒ always-urgent, so a cold
         # runtime flushes the first (possibly singleton) batch at once
-        # and bootstraps the estimate from its observed service time
-        self._ewma: Dict[Any, float] = {}
+        # and bootstraps the estimate from its observed service time.
+        # The MODEL dimension is load-bearing under multiplexing: a
+        # freshly registered model must re-earn its own estimate instead
+        # of inheriting another model's service time (ISSUE 14 satellite
+        # — the cold-start seed applies PER KEY).
+        self._ewma: Dict[Tuple[str, Any, int], float] = {}
+        #: per-model weighted-EDF weights (1.0 = plain EDF); the runtime
+        #: feeds these from the SLO burn rates each decision window
+        self._weights: Dict[str, float] = {}
+        self._weighted = False
+
+    def _plan(self, model: str) -> ModelPlan:
+        try:
+            return self.plans[model]
+        except KeyError:
+            raise KeyError(f"no batching plan for model {model!r} "
+                           f"(registered: {sorted(self.plans)})") from None
+
+    def model_batch(self, model: str) -> int:
+        plan = self._plan(model)
+        return plan.max_batch if plan.max_batch else self.max_batch
+
+    # -- weighted EDF ------------------------------------------------------
+    def set_model_weight(self, model: str, weight: float) -> None:
+        """Set ``model``'s dispatch weight (≥ 1 boosts, the runtime
+        derives it from the model's SLO burn rate).  Slack is divided
+        by the weight in the ready-bucket ordering, so a burning
+        model's buckets win the next dispatch."""
+        if weight <= 0:
+            raise ValueError(f"weight must be > 0, got {weight}")
+        self._weights[model] = float(weight)
+        self._weighted = any(w != 1.0 for w in self._weights.values())
+
+    def model_weight(self, model: str) -> float:
+        return self._weights.get(model, 1.0)
 
     # -- service-time estimate --------------------------------------------
-    def estimate_s(self, edge: Any, n: int, tier: int) -> float:
+    def estimate_s(self, edge: Any, n: int, tier: int,
+                   model: str = DEFAULT_MODEL) -> float:
         if self.service_time is not None:
+            if self.multiplexed:
+                return float(self.service_time(model, edge, n, tier))
             return float(self.service_time(edge, n, tier))
-        return self._ewma.get((edge, tier), float("inf"))
+        return self._ewma.get((model, edge, tier), float("inf"))
 
     def observe_service_s(self, edge: Any, seconds: float, tier: int = 0,
+                          model: str = DEFAULT_MODEL,
                           alpha: float = 0.3) -> None:
-        prev = self._ewma.get((edge, tier))
-        self._ewma[(edge, tier)] = (seconds if prev is None
-                                    else (1 - alpha) * prev + alpha * seconds)
+        key = (model, edge, tier)
+        prev = self._ewma.get(key)
+        self._ewma[key] = (seconds if prev is None
+                           else (1 - alpha) * prev + alpha * seconds)
 
     # -- bucket assignment -------------------------------------------------
     def bucket_of(self, req: Request) -> Any:
-        if self.bucket_edges is None or req.length is None:
+        plan = self._plan(req.model)
+        if plan.bucket_edges is None or req.length is None:
             return FIXED
-        return edge_for(int(req.length), self.bucket_edges)
+        return edge_for(int(req.length), plan.bucket_edges)
 
     # -- assembly ----------------------------------------------------------
-    def _grouped(self) -> Dict[Any, List[Request]]:
-        """Queued requests grouped by bucket, EDF order within each —
-        a read-only view (requests are NOT popped)."""
-        groups: Dict[Any, List[Request]] = {}
-        for r in self.queue.queued_edf():
-            groups.setdefault(self.bucket_of(r), []).append(r)
-        return groups
+    def _group_stats(self) -> Dict[Tuple[str, Optional[int], Any],
+                                   Tuple[int, float]]:
+        """One O(Q) pass over the queued requests: per (model, affinity,
+        edge) group → (count, earliest deadline).  The flush decision
+        needs nothing else, so the heap is neither sorted nor mutated —
+        this is the scan the million-request drill pays per pump."""
+        stats: Dict[Tuple[str, Optional[int], Any], Tuple[int, float]] = {}
+        for r in self.queue.iter_queued():
+            key = (r.model, r.affinity, self.bucket_of(r))
+            cur = stats.get(key)
+            if cur is None:
+                stats[key] = (1, r.deadline_t)
+            else:
+                stats[key] = (cur[0] + 1, min(cur[1], r.deadline_t))
+        return stats
 
-    def next_batch(self, tier: int, force: bool = False
+    def next_batch(self, tier, force: bool = False
                    ) -> Optional[AssembledBatch]:
         """Assemble the most urgent flush-ready batch, or ``None`` when
-        every bucket can still afford to wait.  ``force=True`` (drain)
-        flushes the most urgent non-empty bucket regardless of slack.
-        Expired requests are shed first — never dispatched."""
+        every bucket can still afford to wait.  ``tier`` is the current
+        degradation rung — an int, or a ``{model: tier}`` map in
+        multiplexed mode (each model rides its own ladder).
+        ``force=True`` (drain) flushes the most urgent non-empty bucket
+        regardless of slack.  Expired requests are shed first — never
+        dispatched."""
         self.queue.expire()
-        groups = self._grouped()
-        if not groups:
+        stats = self._group_stats()
+        if not stats:
             return None
+        tiers = tier if isinstance(tier, dict) else None
         now = self.queue.clock.now()
-        ready: List[Any] = []       # (earliest_deadline, edge)
-        for edge, reqs in groups.items():
-            full = len(reqs) >= self.max_batch
-            est = self.estimate_s(edge, min(len(reqs), self.max_batch),
-                                  tier)
-            urgent = (reqs[0].deadline_t - now
-                      <= est + self.slack_margin_s)
+        ready: List[Tuple[float, str, Tuple[str, Optional[int], Any]]] = []
+        for key, (count, earliest) in stats.items():
+            model, _affinity, edge = key
+            cap = self.model_batch(model)
+            m_tier = (tiers.get(model, 0) if tiers is not None
+                      else int(tier))
+            full = count >= cap
+            est = self.estimate_s(edge, min(count, cap), m_tier,
+                                  model=model)
+            urgent = earliest - now <= est + self.slack_margin_s
             if full or urgent or force:
-                ready.append((reqs[0].deadline_t, edge))
+                if self._weighted:
+                    # weighted EDF: positive slack shrinks by the
+                    # model's burn-rate weight; NEGATIVE slack (an
+                    # overdue bucket — possible under
+                    # shed_expired=False) grows in magnitude instead,
+                    # so a burning model ranks more urgent in both
+                    # regimes (division would invert it exactly when
+                    # the bucket is latest).  Equal weights reduce to
+                    # plain EDF either way.
+                    slack = earliest - now
+                    w = self.model_weight(model)
+                    rank = slack / w if slack >= 0 else slack * w
+                else:
+                    rank = earliest
+                ready.append((rank, f"{model}/{_affinity}/{edge}", key))
         if not ready:
             return None
-        _, edge = min(ready, key=lambda t: (t[0], str(t[1])))
+        _, _, key = min(ready, key=lambda t: (t[0], t[1]))
+        model, affinity, edge = key
         taken = self.queue.pop_edf(
-            predicate=lambda r: self.bucket_of(r) == edge,
-            limit=self.max_batch)
-        return self._collate(taken, edge, tier)
+            predicate=lambda r: (r.model == model
+                                 and r.affinity == affinity
+                                 and self.bucket_of(r) == edge),
+            limit=self.model_batch(model))
+        m_tier = tiers.get(model, 0) if tiers is not None else int(tier)
+        return self._collate(taken, edge, m_tier, model=model,
+                             affinity=affinity)
 
-    def _collate(self, reqs: List[Request], edge: Any,
-                 tier: int) -> AssembledBatch:
-        """Pad rows to the bucket edge and the batch axis to
-        ``max_batch`` — both geometries already compiled."""
+    def _collate(self, reqs: List[Request], edge: Any, tier: int,
+                 model: str = DEFAULT_MODEL,
+                 affinity: Optional[int] = None) -> AssembledBatch:
+        """Pad rows to the bucket edge and the batch axis to the model's
+        batch size — both geometries already compiled."""
+        plan = self._plan(model)
+        cap = self.model_batch(model)
         rows, lengths = [], []
         for r in reqs:
-            arr = np.asarray(r.payload[self.pad_key]
+            arr = np.asarray(r.payload[plan.pad_key]
                              if isinstance(r.payload, dict) else r.payload)
             if edge is not FIXED:
                 n = min(int(r.length if r.length is not None
@@ -168,12 +295,19 @@ class DeadlineBatcher:
                 rows.append(arr)
                 lengths.append(arr.shape[0] if arr.ndim else 0)
         n_valid = len(rows)
-        pad = self.max_batch - n_valid
+        pad = cap - n_valid
         if pad:
             rows.extend(np.zeros_like(rows[0]) for _ in range(pad))
             lengths.extend(0 for _ in range(pad))
-        batch: Dict[str, Any] = {self.pad_key: np.stack(rows)}
-        if edge is not FIXED and self.length_key:
-            batch[self.length_key] = np.asarray(lengths, np.int32)
+        batch: Dict[str, Any] = {plan.pad_key: np.stack(rows)}
+        if edge is not FIXED and plan.length_key:
+            batch[plan.length_key] = np.asarray(lengths, np.int32)
+        if plan.streaming:
+            sess = [(-1 if r.session is None else int(r.session))
+                    for r in reqs] + [-1] * pad
+            fin = [int(bool(r.final)) for r in reqs] + [0] * pad
+            batch["session"] = np.asarray(sess, np.int64)
+            batch["final"] = np.asarray(fin, np.int8)
         return AssembledBatch(requests=reqs, batch=batch, edge=edge,
-                              n_valid=n_valid, tier=tier)
+                              n_valid=n_valid, tier=tier, model=model,
+                              affinity=affinity)

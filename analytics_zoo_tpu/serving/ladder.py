@@ -47,13 +47,20 @@ class ServingTier:
     device program — what ``az_analyze --program`` traces, so the
     static audit covers exactly the program this tier dispatches (the
     ``forward`` callable itself is a host closure with decode loops and
-    cannot be traced)."""
+    cannot be traced).
+
+    ``evict_session`` (streaming session tiers, ISSUE 14): drop one
+    session's carry state from this tier instance's store — the
+    runtime calls it on the pinned replica when a session dies without
+    its final chunk ever being served (killed, shed, replica loss), so
+    failed sessions don't leak their state on the replica."""
 
     name: str
     forward: Callable[[Dict[str, Any]], Any]
     speed: float = 1.0
     quality_note: str = ""
     device_program: Optional[Callable[[], tuple]] = None
+    evict_session: Optional[Callable[[int], None]] = None
 
 
 @dataclasses.dataclass

@@ -50,25 +50,45 @@ class ServingMetrics:
         self._tiers: List[int] = []     # tiers with ≥1 completion, sorted
 
     # -- feed ----------------------------------------------------------------
-    def on_submit(self) -> None:
+    # ``model`` (multiplexed runtimes, ISSUE 14): outcomes additionally
+    # land under model-labeled names (``serve/<metric>/model=<m>...``) so
+    # per-model SLOs and the fleet drill read per-model rates; the
+    # unlabeled totals are always maintained, so single-model snapshots
+    # (and the banked RESILIENCE_r03 / OBS_r01 replays) are unchanged.
+    def on_submit(self, model: Optional[str] = None) -> None:
         self._r.counter("serve/submitted").inc()
+        if model is not None:
+            self._r.counter(f"serve/submitted/model={model}").inc()
 
-    def on_shed(self, cause: str) -> None:
+    def on_shed(self, cause: str, model: Optional[str] = None) -> None:
         self._r.counter(f"serve/shed/cause={cause}").inc()
+        if model is not None:
+            self._r.counter(f"serve/shed/model={model}/cause={cause}").inc()
 
-    def on_complete(self, latency_s: float, tier: int, missed: bool) -> None:
+    def on_complete(self, latency_s: float, tier: int, missed: bool,
+                    model: Optional[str] = None) -> None:
         self._r.counter("serve/completed").inc()
         tier = int(tier)
         if tier not in self._tiers:
             self._tiers = sorted(self._tiers + [tier])
         self._r.histogram(f"serve/latency_s/tier={tier}",
                           max_samples=self.reservoir).observe(latency_s)
+        if model is not None:
+            self._r.counter(f"serve/completed/model={model}").inc()
+            self._r.histogram(f"serve/latency_s/model={model}/tier={tier}",
+                              max_samples=self.reservoir).observe(latency_s)
         if missed:
             self.deadline_misses += 1
             self._r.counter("serve/deadline_misses_completed_late").inc()
+            if model is not None:
+                self._r.counter(
+                    f"serve/deadline_misses_completed_late/model={model}"
+                ).inc()
 
-    def on_fail(self) -> None:
+    def on_fail(self, model: Optional[str] = None) -> None:
         self._r.counter("serve/failed").inc()
+        if model is not None:
+            self._r.counter(f"serve/failed/model={model}").inc()
 
     def on_batch(self, n_valid: int, max_batch: int,
                  queue_depth: int) -> None:
@@ -125,16 +145,44 @@ class ServingMetrics:
     def shed_total(self) -> int:
         return sum(self.shed_by_cause.values())
 
-    def miss_rate(self) -> Optional[float]:
+    def miss_rate(self, model: Optional[str] = None) -> Optional[float]:
         """Deadline-miss rate over all requests with a terminal state:
         a shed/timed-out request missed its deadline by definition, a
         completed-late one missed it in the client's hands.  THE number
-        the shedding-vs-baseline comparison uses."""
-        terminal = self.completed + self.failed + self.shed_total
+        the shedding-vs-baseline comparison uses.  ``model`` narrows it
+        to one multiplexed model's requests."""
+        if model is None:
+            completed, failed, shed = (self.completed, self.failed,
+                                       self.shed_total)
+            late = self.deadline_misses
+        else:
+            completed = self._count(f"serve/completed/model={model}")
+            failed = self._count(f"serve/failed/model={model}")
+            prefix = f"serve/shed/model={model}/cause="
+            shed = sum(m.value for name, m in self._r.metrics().items()
+                       if name.startswith(prefix))
+            late = self._count(
+                f"serve/deadline_misses_completed_late/model={model}")
+        terminal = completed + failed + shed
         if terminal == 0:
             return None
-        missed = self.deadline_misses + self.failed + self.shed_total
-        return missed / terminal
+        return (late + failed + shed) / terminal
+
+    def model_snapshot(self, model: str) -> Dict[str, Any]:
+        """Per-model outcome summary for a multiplexed runtime's
+        snapshot (counts + miss rate; latency stays in the registry's
+        model-labeled reservoirs)."""
+        prefix = f"serve/shed/model={model}/cause="
+        return {
+            "submitted": self._count(f"serve/submitted/model={model}"),
+            "completed": self._count(f"serve/completed/model={model}"),
+            "failed": self._count(f"serve/failed/model={model}"),
+            "shed": sum(m.value for name, m in self._r.metrics().items()
+                        if name.startswith(prefix)),
+            "completed_late": self._count(
+                f"serve/deadline_misses_completed_late/model={model}"),
+            "deadline_miss_rate": self.miss_rate(model=model),
+        }
 
     def snapshot(self) -> Dict[str, Any]:
         lat = {}

@@ -1,4 +1,5 @@
-"""Replica health supervision and exactly-once batch failover.
+"""Replica health supervision, exactly-once batch failover, and the
+elastic pool the autoscaler actuates.
 
 A serving cell runs N replicas of the model (N devices, or N mesh
 shards each presenting as one replica).  A replica can fail two ways
@@ -19,24 +20,60 @@ Either way the pool
    next dispatch cycle re-admits the replica (and its jit cache is
    assumed cold, which is why restarts must not be free).
 
+**Fence budget** (ISSUE 14 satellite — the OBS_r02 p99 fix): by default
+a wedged forward is only *observed* when it finally returns, so its
+batch rides out the whole stall before re-dispatch — exactly the
+``failover_redispatch`` segment the banked tail attribution blamed for
+95 % of the p99 cohort gap.  ``ReplicaPool(fence_budget_s=...)`` bounds
+that: every virtual sleep inside a supervised forward goes through the
+budget guard, and the moment the forward's elapsed time would cross the
+budget the replica raises :class:`ReplicaWedged` *at the fence instant*
+— the pool fences and re-dispatches **on the fence**, not on the wedged
+forward's eventual return, so the redispatch segment is bounded by the
+knob.  ``None`` keeps the PR-5 return-then-check behavior (the banked
+RESILIENCE_r03 / OBS_r01 / OBS_r02 replays are byte-identical).
+
+**Elasticity** (ISSUE 14 tentpole): :meth:`ReplicaPool.resize` is the
+autoscaler's actuator.  Growth builds replicas through the pool's
+``replica_factory`` and — when compiled-geometry modeling is armed
+(``compile_s`` > 0 with a ``prewarm_keys`` plan) — **pre-warms** them:
+the new replica sits in state ``warming`` while its per-(model, edge,
+tier) programs compile, joining dispatch only once every planned
+geometry is resident, so a burst-driven scale-up never serves a cold
+jit cache.  With ``prewarm=False`` the replica joins immediately cold
+and its first dispatch of each geometry pays the ``compile_s`` tax on
+the hot path (a ``cold_compile`` event per geometry) — the serving-
+scale drill banks exactly that delta.  Shrink is **drain-then-retire**:
+the victim stops receiving batches (state ``draining``), any in-flight
+batch finishes or re-dispatches exactly once through the ordinary
+failover latch, and the replica is removed once idle — never with work
+on it.
+
 Supervision is PULL-mode :class:`StallWatchdog` on the runtime's clock:
 ``beat`` when the forward starts, ``check`` when it returns.  A forward
 whose (possibly virtual) duration exceeds ``wedge_timeout_s`` is a
 wedge even though it eventually returned — in production the push-mode
 monitor thread would have interrupted it mid-flight; on the virtual
-clock the pull check observes the same deadline deterministically.
+clock the pull check observes the same deadline deterministically (and
+the fence budget models the push-mode interrupt itself).
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import (Any, Callable, Dict, List, Optional, Sequence, Set,
+                    Tuple)
 
 from analytics_zoo_tpu.resilience.errors import ReplicaWedged, StallError
 from analytics_zoo_tpu.resilience.watchdog import StallWatchdog
 from analytics_zoo_tpu.serving.batcher import AssembledBatch
+from analytics_zoo_tpu.serving.request import DEFAULT_MODEL
 
 logger = logging.getLogger("analytics_zoo_tpu")
+
+#: a (model, edge, tier) compiled-geometry key — what pre-warm plans
+#: enumerate and ``warm_keys`` tracks
+GeometryKey = Tuple[str, Any, int]
 
 
 class Replica:
@@ -44,28 +81,116 @@ class Replica:
 
     ``forward_fns`` maps degradation-tier index → callable
     ``batch_dict -> outputs`` (every tier's geometry pre-compiled on
-    this replica's device).  ``service_hook`` (optional) returns the
-    simulated service seconds for a dispatch — the virtual-clock path;
-    when ``None`` the real forward's wall time is what the watchdog
-    sees.
+    this replica's device) — a list for single-model runtimes, or a
+    ``{model: [tier fns]}`` dict for a multiplexed one (ISSUE 14).
+    ``service_hook`` (optional) returns the simulated service seconds
+    for a dispatch — the virtual-clock path; when ``None`` the real
+    forward's wall time is what the watchdog sees.
+
+    ``warm_keys``: the compiled-geometry set this replica holds.
+    ``None`` (default) disables compile modeling — everything is warm,
+    the PR-5 behavior.  A set (possibly empty) arms it: dispatching a
+    (model, edge, tier) not in the set pays ``compile_s`` on the hot
+    path first (a *cold compile*), exactly the latency cliff pre-warm
+    exists to delete.
     """
 
-    def __init__(self, rid: int, forward_fns: Sequence[Callable],
-                 clock, wedge_timeout_s: float,
-                 service_hook: Optional[Callable[..., float]] = None):
+    def __init__(self, rid: int, forward_fns, clock,
+                 wedge_timeout_s: float,
+                 service_hook: Optional[Callable[..., float]] = None,
+                 fence_budget_s: Optional[float] = None,
+                 compile_s: float = 0.0,
+                 warm_keys: Optional[Set[GeometryKey]] = None):
         self.rid = rid
-        self.forward_fns = list(forward_fns)
+        if isinstance(forward_fns, dict):
+            self.forward_fns: Dict[str, List[Callable]] = {
+                m: list(fns) for m, fns in forward_fns.items()}
+        else:
+            self.forward_fns = {DEFAULT_MODEL: list(forward_fns)}
         self.clock = clock
         self.service_hook = service_hook
-        self.state = "healthy"          # healthy|fenced
+        self.fence_budget_s = fence_budget_s
+        self.compile_s = float(compile_s)
+        self.warm_keys = warm_keys
+        self.state = "healthy"       # healthy|fenced|warming|draining
         self.restart_at: Optional[float] = None
+        self.warm_ready_at: Optional[float] = None
+        self._warm_plan: Sequence[GeometryKey] = ()
         self.dispatches = 0
         self.wedges = 0
+        self.cold_compiles = 0
+        self.inflight = 0            # batches currently on this replica
+        #: parallel-service mode (ISSUE 14, the fleet drill's capacity
+        #: model): the virtual instant this replica's last assigned
+        #: batch completes — replicas serve CONCURRENTLY, each
+        #: sequentially, and the runtime only assigns to free ones
+        self.busy_until = 0.0
+        self.observer: Optional[Callable[[Dict[str, Any]], None]] = None
+        #: per-model ServingTier instances this replica serves (set by
+        #: the runtime) — how session state eviction reaches the tier's
+        #: per-replica store (``ServingTier.evict_session``)
+        self.tier_objs: Dict[str, List[Any]] = {}
+        self._fence_t: Optional[float] = None
         # one time-source convention (utils.clock): the watchdog takes
         # the Clock object itself since PR 7, no .now unwrapping
         self.watchdog = StallWatchdog(
             timeout_s=wedge_timeout_s, name=f"replica-{rid}",
             clock=clock)
+
+    def _fn_for(self, batch: AssembledBatch) -> Callable:
+        try:
+            return self.forward_fns[batch.model][batch.tier]
+        except (KeyError, IndexError):
+            raise ReplicaWedged(
+                f"replica {self.rid}: no forward for model "
+                f"{batch.model!r} tier {batch.tier}") from None
+
+    def sleep_guarded(self, seconds: float) -> None:
+        """Advance virtual time inside a supervised forward, bounded by
+        the fence budget: crossing it sleeps only UP TO the fence
+        instant and raises :class:`ReplicaWedged` there — the push-mode
+        monitor interrupting the wedge mid-flight, modeled exactly on
+        the pull-mode clock.  With no budget this is a plain sleep (the
+        PR-5 return-then-check path, byte-identical)."""
+        if self._fence_t is None:
+            self.clock.sleep(seconds)
+            return
+        now = self.clock.now()
+        if now + seconds > self._fence_t:
+            self.clock.sleep(max(self._fence_t - now, 0.0))
+            raise ReplicaWedged(
+                f"replica {self.rid}: forward wedged mid-flight — fenced "
+                f"at the {self.fence_budget_s:.3f}s fence budget")
+        self.clock.sleep(seconds)
+
+    def cold_tax(self, batch: AssembledBatch, mark: bool = True) -> float:
+        """The cold-compile tax this dispatch pays: ``compile_s`` when
+        the replica has never compiled the batch's geometry (pre-warm's
+        counterfactual), else 0.  Records the ``cold_compile`` event and
+        (with ``mark``) the now-resident key."""
+        if self.warm_keys is None or self.compile_s <= 0:
+            return 0.0
+        key = (batch.model, batch.edge, batch.tier)
+        if key in self.warm_keys:
+            return 0.0
+        self.cold_compiles += 1
+        if self.observer is not None:
+            self.observer({"kind": "cold_compile", "replica": self.rid,
+                           "model": batch.model, "edge": str(batch.edge),
+                           "tier": batch.tier,
+                           "t": round(self.clock.now(), 6)})
+        if mark:
+            self.warm_keys.add(key)
+        return self.compile_s
+
+    def _maybe_cold_compile(self, batch: AssembledBatch) -> None:
+        tax = self.cold_tax(batch, mark=False)
+        if tax <= 0:
+            return
+        self.sleep_guarded(tax)
+        # marked warm only once the compile completed (a fence mid-
+        # compile leaves the geometry cold for the restarted replica)
+        self.warm_keys.add((batch.model, batch.edge, batch.tier))
 
     def forward(self, batch: AssembledBatch,
                 fault: Optional[Callable[["Replica"], None]] = None) -> Any:
@@ -75,21 +200,28 @@ class Replica:
         crash or deadline overrun; the POOL owns fencing/failover."""
         self.watchdog.beat()
         self.dispatches += 1
+        self.inflight += 1
         t0 = self.clock.now()
+        self._fence_t = (t0 + self.fence_budget_s
+                         if self.fence_budget_s is not None else None)
         try:
             if fault is not None:
                 fault(self)
-            out = self.forward_fns[batch.tier](batch.batch)
+            self._maybe_cold_compile(batch)
+            out = self._fn_for(batch)(batch.batch)
+            if self.service_hook is not None:
+                # virtual time: the hook says how long this forward took
+                self.sleep_guarded(float(self.service_hook(batch,
+                                                           self.rid)))
         except ReplicaWedged:
             raise
         except Exception as e:
             raise ReplicaWedged(
                 f"replica {self.rid}: forward crashed mid-batch "
                 f"({type(e).__name__}: {e})") from e
-        if self.service_hook is not None:
-            # virtual time: the hook says how long this forward took
-            self.clock.sleep(float(self.service_hook(
-                batch.edge, batch.n_valid, batch.tier, self.rid)))
+        finally:
+            self.inflight -= 1
+            self._fence_t = None
         try:
             self.watchdog.check()
         except StallError as e:
@@ -117,18 +249,55 @@ class Replica:
             return True
         return False
 
+    def begin_warming(self, plan: Sequence[GeometryKey],
+                      ready_at: float) -> None:
+        """Enter the pre-warm phase: compile every planned geometry OFF
+        the dispatch path; :meth:`maybe_warm` admits the replica once
+        they are all resident."""
+        self.state = "warming"
+        self._warm_plan = tuple(plan)
+        self.warm_ready_at = ready_at
+        self.warm_keys = set()
+
+    def maybe_warm(self, now: float) -> bool:
+        """Join dispatch once the pre-warm compiles completed — the
+        replica becomes eligible with every planned geometry warm."""
+        if self.state == "warming" and self.warm_ready_at is not None \
+                and now >= self.warm_ready_at:
+            self.state = "healthy"
+            self.warm_ready_at = None
+            self.warm_keys = set(self._warm_plan)
+            self._warm_plan = ()
+            self.watchdog.reset()
+            return True
+        return False
+
 
 class ReplicaPool:
     """Round-robin dispatch over healthy replicas with fence + exactly-
-    once failover.  ``events`` is the deterministic log the drill banks
-    (no wall-clock entries beyond the runtime clock's virtual time).
-    ``observer`` (optional, set by the runtime) sees every event as it
-    is appended — the telemetry spine's flight recorder hangs off it,
-    and a fence event is one of the black box's dump triggers."""
+    once failover, plus the resize actuator the autoscaler drives.
+    ``events`` is the deterministic log the drill banks (no wall-clock
+    entries beyond the runtime clock's virtual time).  ``observer``
+    (optional, set by the runtime) sees every event as it is appended —
+    the telemetry spine's flight recorder hangs off it, and a fence
+    event is one of the black box's dump triggers.
+
+    ``fence_budget_s``: the wedge-detection bound (see the module
+    docstring) — assigned to every replica that doesn't carry its own.
+    ``replica_factory(rid) -> Replica``: how :meth:`resize` builds
+    growth replicas (the runtime wires one that mirrors its own replica
+    construction).  ``prewarm_keys``/``compile_s``: the compiled-
+    geometry plan and per-program compile cost the pre-warm/cold-
+    compile modeling uses (``compile_s == 0`` disables it — the PR-5
+    behavior)."""
 
     def __init__(self, replicas: Sequence[Replica], clock,
                  restart_s: float = 5.0,
-                 observer: Optional[Callable[[Dict[str, Any]], None]] = None):
+                 observer: Optional[Callable[[Dict[str, Any]], None]] = None,
+                 fence_budget_s: Optional[float] = None,
+                 replica_factory: Optional[Callable[[int], Replica]] = None,
+                 prewarm_keys: Optional[Sequence[GeometryKey]] = None,
+                 compile_s: float = 0.0):
         if not replicas:
             raise ValueError("need at least one replica")
         self.replicas = list(replicas)
@@ -136,7 +305,19 @@ class ReplicaPool:
         self.restart_s = float(restart_s)
         self.events: List[Dict[str, Any]] = []
         self.observer = observer
+        self.fence_budget_s = fence_budget_s
+        self.replica_factory = replica_factory
+        self.prewarm_keys = tuple(prewarm_keys) if prewarm_keys else ()
+        self.compile_s = float(compile_s)
         self._rr = 0
+        self._rid_counter = max(r.rid for r in self.replicas) + 1
+        for r in self.replicas:
+            self._adopt(r)
+
+    def _adopt(self, r: Replica) -> None:
+        if r.fence_budget_s is None:
+            r.fence_budget_s = self.fence_budget_s
+        r.observer = self._event
 
     def _event(self, ev: Dict[str, Any]) -> None:
         self.events.append(ev)
@@ -146,14 +327,38 @@ class ReplicaPool:
     # -- selection -----------------------------------------------------------
     def _revive(self) -> None:
         now = self.clock.now()
+        retired: List[Replica] = []
         for r in self.replicas:
             if r.maybe_restart(now):
                 self._event({"kind": "replica_restarted",
                              "replica": r.rid, "t": round(now, 6)})
+            elif r.maybe_warm(now):
+                self._event({"kind": "replica_prewarmed",
+                             "replica": r.rid, "t": round(now, 6),
+                             "geometries": len(r.warm_keys or ())})
+            elif r.state == "draining" and r.inflight == 0 \
+                    and r.busy_until <= now:
+                retired.append(r)
+        for r in retired:
+            self.replicas.remove(r)
+            self._event({"kind": "replica_retired", "replica": r.rid,
+                         "t": round(now, 6)})
 
     def healthy(self) -> List[Replica]:
         self._revive()
         return [r for r in self.replicas if r.state == "healthy"]
+
+    @property
+    def size(self) -> int:
+        """Pool size the autoscaler reasons about: every replica that
+        is, or will come back as, dispatchable (healthy, fenced-with-
+        restart-pending, warming) — draining replicas are already on
+        their way out."""
+        return sum(r.state != "draining" for r in self.replicas)
+
+    @property
+    def cold_compiles(self) -> int:
+        return sum(r.cold_compiles for r in self.replicas)
 
     def pick(self, exclude: Optional[int] = None) -> Optional[Replica]:
         """Deterministic round-robin over healthy replicas (skipping
@@ -164,6 +369,120 @@ class ReplicaPool:
         r = ready[self._rr % len(ready)]
         self._rr += 1
         return r
+
+    def replica_by_rid(self, rid: int) -> Optional[Replica]:
+        for r in self.replicas:
+            if r.rid == rid:
+                return r
+        return None
+
+    # -- parallel service (the fleet capacity model) --------------------------
+    def any_free(self, now: float) -> bool:
+        return any(r.busy_until <= now for r in self.healthy())
+
+    def pick_free(self, now: float,
+                  exclude: Optional[int] = None) -> Optional[Replica]:
+        """Round-robin over healthy replicas that are FREE at ``now`` —
+        parallel-service mode's assignment rule (a busy replica is
+        serving its previous batch concurrently)."""
+        ready = [r for r in self.healthy()
+                 if r.busy_until <= now and r.rid != exclude]
+        if not ready:
+            return None
+        r = ready[self._rr % len(ready)]
+        self._rr += 1
+        return r
+
+    def least_busy(self) -> Optional[Replica]:
+        """Healthy replica with the earliest busy horizon — the force-
+        drain path queues work there when nobody is free."""
+        ready = self.healthy()
+        if not ready:
+            return None
+        return min(ready, key=lambda r: (r.busy_until, r.rid))
+
+    def next_event_t(self, now: float) -> Optional[float]:
+        """The next virtual instant pool state changes (a busy replica
+        frees, a restart completes, a pre-warm finishes) — what an
+        event-driven load loop advances the clock to."""
+        ts: List[float] = []
+        for r in self.replicas:
+            if r.busy_until > now:
+                ts.append(r.busy_until)
+            if r.state == "fenced" and r.restart_at is not None \
+                    and r.restart_at > now:
+                ts.append(r.restart_at)
+            if r.state == "warming" and r.warm_ready_at is not None \
+                    and r.warm_ready_at > now:
+                ts.append(r.warm_ready_at)
+        return min(ts) if ts else None
+
+    # -- resize (the autoscaler's actuator) ----------------------------------
+    def resize(self, n: int, prewarm: bool = True,
+               protected: Sequence[int] = ()) -> Dict[str, List[int]]:
+        """Grow or shrink the pool to ``n`` non-draining replicas.
+
+        Growth builds replicas through ``replica_factory``; with
+        compile modeling armed they **pre-warm** first (state
+        ``warming`` for ``compile_s × len(prewarm_keys)`` of clock
+        time, then join with every planned geometry warm) unless
+        ``prewarm=False`` — then they join immediately cold and pay the
+        tax per first dispatch.  Shrink is drain-then-retire: victims
+        (fenced first, then the highest-rid healthy replica not in
+        ``protected`` — session-pinned replicas are never drained while
+        an alternative exists) stop receiving batches at once and are
+        removed when idle; in-flight work finishes or re-dispatches
+        exactly once through the ordinary failover latch.  Returns the
+        rids acted on."""
+        if n < 1:
+            raise ValueError(f"pool size must be >= 1, got {n}")
+        self._revive()
+        protected_set = set(protected)
+        actions: Dict[str, List[int]] = {"grown": [], "drained": []}
+        while self.size < n:
+            if self.replica_factory is None:
+                raise RuntimeError("pool growth needs a replica_factory")
+            rid = self._rid_counter
+            self._rid_counter += 1
+            r = self.replica_factory(rid)
+            r.compile_s = self.compile_s
+            self._adopt(r)
+            now = self.clock.now()
+            modeled = self.compile_s > 0 and self.prewarm_keys
+            if modeled and prewarm:
+                r.begin_warming(
+                    self.prewarm_keys,
+                    now + self.compile_s * len(self.prewarm_keys))
+            elif modeled:
+                r.warm_keys = set()     # joins cold: pays per-dispatch tax
+            self.replicas.append(r)
+            self._event({"kind": "replica_joined", "replica": rid,
+                         "t": round(now, 6), "prewarm": bool(prewarm),
+                         "state": r.state})
+            actions["grown"].append(rid)
+        while self.size > n:
+            # a fenced replica is the cheapest victim — UNLESS sessions
+            # are pinned to it: it restarts with their state intact,
+            # while retiring it would lose them permanently
+            victims = [r for r in self.replicas if r.state == "fenced"
+                       and r.rid not in protected_set]
+            if not victims:
+                victims = sorted(
+                    (r for r in self.replicas
+                     if r.state in ("healthy", "warming")
+                     and r.rid not in protected_set),
+                    key=lambda r: -r.rid)
+            if not victims:
+                break                   # everything left is protected
+            victim = victims[0]
+            victim.state = "draining"
+            self._event({"kind": "replica_draining",
+                         "replica": victim.rid,
+                         "t": round(self.clock.now(), 6),
+                         "inflight": victim.inflight})
+            actions["drained"].append(victim.rid)
+        self._revive()                  # idle victims retire immediately
+        return actions
 
     # -- dispatch with failover ----------------------------------------------
     def _fence(self, replica: Replica, err: ReplicaWedged) -> None:
@@ -183,7 +502,28 @@ class ReplicaPool:
         fence the replica and re-dispatch EXACTLY once.  Returns the
         forward outputs; raises :class:`ReplicaWedged` when the retry is
         spent or no healthy replica remains (the runtime fails the
-        batch's requests — retryable from the client's side)."""
+        batch's requests — retryable from the client's side).
+
+        A batch with ``affinity`` set (a streaming-session batch) MUST
+        run on that replica — its RNN carry lives there, so failover to
+        another replica would silently decode from zeroed state; if the
+        pinned replica is gone or unhealthy the batch fails instead
+        (honest state loss, the runtime fails its requests)."""
+        if batch.affinity is not None:
+            self._revive()
+            replica = self.replica_by_rid(batch.affinity)
+            if replica is None or replica.state != "healthy":
+                raise ReplicaWedged(
+                    f"session replica {batch.affinity} unavailable "
+                    f"(state: "
+                    f"{replica.state if replica else 'retired'}) — "
+                    f"session state lost")
+            fault = fault_for(replica) if fault_for is not None else None
+            try:
+                return self.dispatch_on(replica, batch, fault)
+            except ReplicaWedged as err:
+                self._fence(replica, err)
+                raise
         replica = self.pick()
         if replica is None:
             raise ReplicaWedged("no healthy replica available")

@@ -36,6 +36,10 @@ from analytics_zoo_tpu.resilience.errors import (RequestTimeout,
 #: every submitted request ends in exactly one of these (none lost)
 TERMINAL_STATES = ("done", "shed", "timeout", "failed")
 
+#: the model name single-model runtimes serve under — multiplexed
+#: runtimes (``ServingRuntime(models=...)``) key everything per model
+DEFAULT_MODEL = "default"
+
 
 @dataclasses.dataclass
 class Request:
@@ -45,6 +49,15 @@ class Request:
     ``length`` is the sample's variable-axis length for bucket
     assignment (``None`` for fixed-shape models).  ``deadline_t`` is
     ABSOLUTE clock time; slack = ``deadline_t - now``.
+
+    Multiplexing (ISSUE 14): ``model`` names which registered model the
+    request is for — the batcher never mixes models in one batch and
+    the replica dispatches the (model, tier) forward.  Streaming
+    sessions additionally carry ``session`` (the session id) and
+    ``affinity`` (the replica rid the session's carry state lives on —
+    the batcher only groups equal-affinity requests and the pool
+    dispatches to exactly that replica); ``final`` marks the session's
+    flush chunk.
     """
 
     rid: int
@@ -58,6 +71,10 @@ class Request:
     completed_t: Optional[float] = None
     tier: Optional[int] = None      # degradation tier that served it
     attempts: int = 0               # device dispatches (failover ≤ 2)
+    model: str = DEFAULT_MODEL      # which multiplexed model (ISSUE 14)
+    session: Optional[int] = None   # streaming session id
+    affinity: Optional[int] = None  # replica rid the session is pinned to
+    final: bool = False             # session flush chunk
 
     @property
     def finished(self) -> bool:
@@ -144,6 +161,13 @@ class AdmissionQueue:
             self._shed(req, "queue_full", err)
             raise err
         heapq.heappush(self._heap, (req.deadline_t, next(self._seq), req))
+
+    def iter_queued(self):
+        """Queued requests in ARBITRARY order — the batcher's O(Q)
+        group-stats scan (no sort, no mutation; use :meth:`queued_edf`
+        when order matters)."""
+        for entry in self._heap:
+            yield entry[2]
 
     def queued_edf(self) -> List[Request]:
         """Queued requests in EDF order — a read-only view for the

@@ -24,6 +24,25 @@ async dispatch providing the device overlap (the
 deterministically — that is what ``tests/test_serving.py`` and
 ``tools/serve_drill.py`` pin.
 
+**Fleet mode** (ISSUE 14 — the Clipper model-multiplexing frontend +
+Clockwork predictability discipline): pass ``models=[ModelConfig(...),
+...]`` instead of ``tiers`` and ONE runtime schedules several model
+families on the SHARED replica pool — per-model batching geometry
+(models never share a batch), per-model degradation ladders, per-model
+SLOs whose burn rates weight the EDF dispatch order (a burning model's
+slack counts for more), and per-model service-time EWMAs (a new model
+never inherits another's estimate).  Streaming session models
+(``ModelConfig(streaming=True)``) get session-affine scheduling:
+:meth:`open_session` pins a session to one replica (where its carry
+state lives), every :meth:`submit_chunk` carries an incremental
+per-chunk deadline, and chunk order is preserved because chunk
+deadlines are monotone under EDF.  A closed-loop
+:class:`~analytics_zoo_tpu.serving.autoscale.Autoscaler` (``autoscaler=``)
+turns the PR-11 ``SloDecision.scale_hint`` into actual
+:meth:`~analytics_zoo_tpu.serving.replica.ReplicaPool.resize` calls —
+growth pre-warms compiled geometries before the replica joins dispatch,
+shrink drains-then-retires.
+
 Usage::
 
     tiers = ssd_serving_tiers(model, param)       # pipelines.ssd hook
@@ -38,21 +57,26 @@ Usage::
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import logging
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import (Any, Callable, Dict, List, Optional, Sequence, Set,
+                    Tuple)
 
 import numpy as np
 
-from analytics_zoo_tpu.resilience.errors import ReplicaWedged
+from analytics_zoo_tpu.resilience.errors import (ReplicaWedged,
+                                                 ServerOverloaded)
 from analytics_zoo_tpu.serving.batcher import (AssembledBatch,
-                                               DeadlineBatcher)
+                                               DeadlineBatcher, FIXED,
+                                               ModelPlan)
 from analytics_zoo_tpu.serving.clock import Clock, MonotonicClock
 from analytics_zoo_tpu.serving.ladder import (DegradationLadder,
                                               LadderPolicy, ServingTier)
 from analytics_zoo_tpu.serving.metrics import ServingMetrics
 from analytics_zoo_tpu.serving.replica import Replica, ReplicaPool
-from analytics_zoo_tpu.serving.request import AdmissionQueue, Request
+from analytics_zoo_tpu.serving.request import (DEFAULT_MODEL,
+                                               AdmissionQueue, Request)
 
 #: span trace-id for one request's life (submit → terminal) — the
 #: obs.span_conservation check keys on this prefix
@@ -61,15 +85,82 @@ REQ_TRACE = "req-{rid}"
 logger = logging.getLogger("analytics_zoo_tpu")
 
 
+@dataclasses.dataclass
+class ModelConfig:
+    """One multiplexed model family on the shared pool (ISSUE 14).
+
+    ``tiers``: the degradation rungs (cheapest last — the same
+    descriptors the single-model runtime takes).  ``tier_factory``
+    (optional): ``replica_rid -> [ServingTier]`` building PER-REPLICA
+    tier instances — how streaming models give every replica its own
+    session-state store, so session affinity is physically meaningful;
+    ``tiers`` stays the template (names/speeds/audit hooks).
+
+    ``bucket_edges``/``pad_key``/``length_key``/``max_batch``: the
+    model's batching plan (see :class:`~analytics_zoo_tpu.serving.
+    batcher.ModelPlan`).  ``default_deadline_s``: per-model deadline
+    when ``submit`` doesn't pass one (``None`` = the runtime default).
+    ``slos``: this model's objectives (:mod:`analytics_zoo_tpu.obs.slo`
+    — e.g. ``model_slos(name)``); their burn rates drive the model's
+    ladder and its weighted-EDF dispatch weight.  ``streaming`` marks a
+    session-type model (``open_session``/``submit_chunk``) with
+    ``chunk_deadline_s`` as the per-chunk incremental deadline.
+    """
+
+    name: str
+    tiers: Sequence[ServingTier]
+    tier_factory: Optional[Callable[[int], Sequence[ServingTier]]] = None
+    bucket_edges: Optional[Sequence[int]] = None
+    pad_key: str = "input"
+    length_key: Optional[str] = "n_frames"
+    max_batch: Optional[int] = None
+    default_deadline_s: Optional[float] = None
+    slos: Sequence[Any] = ()
+    streaming: bool = False
+    chunk_deadline_s: float = 0.5
+    ladder_policy: Optional[LadderPolicy] = None
+
+    def __post_init__(self):
+        if not self.tiers:
+            raise ValueError(f"model {self.name!r} needs at least one tier")
+        if self.streaming and self.tier_factory is None:
+            raise ValueError(
+                f"streaming model {self.name!r} needs a tier_factory — "
+                f"session carry state must live per replica for session "
+                f"affinity to mean anything")
+        if self.streaming and self.bucket_edges \
+                and len(self.bucket_edges) > 1:
+            # chunk order relies on EDF within ONE (model, affinity,
+            # edge) group: with several edges a session's later chunk
+            # could land in a bucket that flushes first and decode out
+            # of order.  Session chunks are fixed-size blocks anyway
+            # (StreamingDS2 compiles exactly three shapes).
+            raise ValueError(
+                f"streaming model {self.name!r} may declare at most one "
+                f"bucket edge — multiple edges would let a later chunk's "
+                f"bucket flush before an earlier chunk's, breaking "
+                f"in-order decode")
+
+    def plan(self) -> ModelPlan:
+        return ModelPlan(bucket_edges=self.bucket_edges,
+                         pad_key=self.pad_key, length_key=self.length_key,
+                         max_batch=self.max_batch,
+                         streaming=self.streaming)
+
+
 class ServingRuntime:
     """Deadline-aware serving over N supervised replicas.
 
     ``tiers``: degradation rungs, cheapest last (see
     ``pipelines.ssd.ssd_serving_tiers`` / ``pipelines.deepspeech2.
-    ds2_serving_tiers``).  ``service_time(edge, n, tier)``: estimated
-    service seconds — REQUIRED with a virtual clock (it also advances
-    it); with the default monotonic clock it may be ``None`` (the
-    batcher then learns an EWMA from observed forwards).
+    ds2_serving_tiers``) — the single-model path.  ``models``: a list of
+    :class:`ModelConfig` instead, for the multiplexed fleet path
+    (``tiers`` must then be ``None``).  ``service_time(edge, n, tier)``
+    (single-model) / ``service_time(model, edge, n, tier)``
+    (multiplexed): estimated service seconds — REQUIRED with a virtual
+    clock (it also advances it); with the default monotonic clock it
+    may be ``None`` (the batcher then learns a per-(model, edge, tier)
+    EWMA from observed forwards).
 
     ``chaos``: an armed :class:`~analytics_zoo_tpu.resilience.chaos.
     ChaosMonkey` whose serving-kind windows (``slow_forward``,
@@ -81,9 +172,26 @@ class ServingRuntime:
     degradation ladder steps on ``SloDecision.overloaded`` (SLO burn)
     instead of the raw shed/queue-depth flag; each decision is noted
     into the flight recorder (``slo_decision`` events) when ``obs`` is
-    armed, and ``snapshot()`` carries the SLO report.  The same
-    evaluator's ``scale_hint`` is the autoscaler input (ROADMAP
-    item 1).
+    armed, and ``snapshot()`` carries the SLO report.  In fleet mode
+    the runtime BUILDS the evaluator from the models' declared SLOs
+    when none is passed (``slo_params`` forwards evaluator kwargs like
+    ``time_scale``), maps each burning SLO back to its model for the
+    per-model ladders, and refreshes the weighted-EDF weights from the
+    fast-window burns every decision window.
+
+    ``autoscaler``: an armed :class:`~analytics_zoo_tpu.serving.
+    autoscale.Autoscaler` — the decision window's ``scale_hint`` feeds
+    its policy loop and a due actuation calls ``pool.resize`` (growth
+    pre-warmed per ``compile_s``/the models' geometry plan, shrink
+    drain-then-retire, session-pinned replicas protected).
+
+    ``fence_budget_s``: bounds wedge detection (see
+    :mod:`analytics_zoo_tpu.serving.replica`) — ``None`` keeps the
+    PR-5 return-then-check behavior.  ``compile_s``: per-geometry
+    compile cost for the pre-warm / cold-compile modeling (0 disables).
+    ``retain_requests=False`` drops per-request objects once terminal
+    (accounting stays exact via incremental counters) — the
+    million-request drill's memory bound.
 
     ``specs``: the pipeline's declared
     :class:`~analytics_zoo_tpu.parallel.specs.SpecSet` — pass the SAME
@@ -94,7 +202,8 @@ class ServingRuntime:
     ``snapshot()`` so a banked drill names the serving geometry.
     """
 
-    def __init__(self, tiers: Sequence[ServingTier], n_replicas: int = 2,
+    def __init__(self, tiers: Optional[Sequence[ServingTier]] = None,
+                 n_replicas: int = 2,
                  clock: Optional[Clock] = None,
                  queue_capacity: int = 64, max_batch: int = 8,
                  bucket_edges: Optional[Sequence[int]] = None,
@@ -103,27 +212,68 @@ class ServingRuntime:
                  default_deadline_s: float = 1.0,
                  wedge_timeout_s: float = 10.0,
                  restart_s: float = 5.0,
-                 service_time: Optional[
-                     Callable[[Any, int, int], float]] = None,
+                 service_time: Optional[Callable[..., float]] = None,
                  slack_margin_s: float = 0.0,
                  ladder_policy: Optional[LadderPolicy] = None,
                  decision_every: int = 8,
                  shed_expired: bool = True,
-                 chaos=None, obs=None, specs=None, slo=None):
-        if not tiers:
-            raise ValueError("need at least one ServingTier")
-        self.tiers = list(tiers)
+                 chaos=None, obs=None, specs=None, slo=None,
+                 models: Optional[Sequence[ModelConfig]] = None,
+                 autoscaler=None,
+                 fence_budget_s: Optional[float] = None,
+                 compile_s: float = 0.0,
+                 slo_params: Optional[Dict[str, Any]] = None,
+                 weight_cap: float = 4.0,
+                 retain_requests: bool = True,
+                 parallel_replicas: bool = False):
+        if models is not None:
+            if tiers is not None:
+                raise ValueError("pass tiers= OR models=, not both")
+            if not models:
+                raise ValueError("models= must name at least one model")
+            self.models: Dict[str, ModelConfig] = {}
+            for cfg in models:
+                if cfg.name in self.models:
+                    raise ValueError(f"duplicate model name {cfg.name!r}")
+                self.models[cfg.name] = cfg
+            self._multi = True
+            self.tiers = None
+        else:
+            if not tiers:
+                raise ValueError("need at least one ServingTier")
+            self.tiers = list(tiers)
+            self.models = {DEFAULT_MODEL: ModelConfig(
+                name=DEFAULT_MODEL, tiers=self.tiers,
+                bucket_edges=bucket_edges, pad_key=pad_key,
+                length_key=length_key)}
+            self._multi = False
         self.specs = specs
         self.clock = clock or MonotonicClock()
         self.default_deadline_s = float(default_deadline_s)
         self.max_batch = int(max_batch)
         self.decision_every = int(decision_every)
+        self.wedge_timeout_s = float(wedge_timeout_s)
         self.chaos = chaos
-        # SLO engine (obs.slo.SloEvaluator): when armed, each decision
-        # window feeds a registry snapshot through the multi-window
-        # burn-rate evaluation and the ladder steps on SLO burn instead
-        # of the raw shed/depth flag (see _decide_window)
-        self.slo = slo
+        self.weight_cap = float(weight_cap)
+        self.retain_requests = bool(retain_requests)
+        # parallel-service mode (the fleet capacity model): dispatch
+        # assigns a batch to a FREE replica whose completion lands at
+        # start + cold_tax + service on ITS busy horizon — replicas
+        # serve concurrently and pool size IS capacity.  The legacy
+        # serial mode (every dispatch sleeps the shared clock) stays
+        # the default: the PR-5/PR-11 drills replay byte-identically,
+        # and chaos wedge/crash injection lives there.
+        self.parallel = bool(parallel_replicas)
+        if self.parallel:
+            if service_time is None:
+                raise ValueError("parallel_replicas needs a service_time "
+                                 "model (it is a virtual-time mode)")
+            if chaos is not None:
+                raise ValueError("parallel_replicas does not support "
+                                 "chaos injection (serial mode does)")
+            if obs is not None:
+                raise ValueError("parallel_replicas does not thread "
+                                 "request spans (serial mode does)")
         # telemetry spine (obs.Observability): request-lifecycle spans
         # into the flight recorder, metrics into the shared registry; a
         # replica fence dumps the black box when a dump_path is armed
@@ -132,40 +282,137 @@ class ServingRuntime:
             obs.adopt_clock(self.clock)
         self.metrics = ServingMetrics(
             registry=obs.registry if obs is not None else None)
+        # SLO engine (obs.slo.SloEvaluator): when armed, each decision
+        # window feeds a registry snapshot through the multi-window
+        # burn-rate evaluation and the ladder steps on SLO burn instead
+        # of the raw shed/depth flag (see _decide_window).  Fleet mode
+        # builds it from the models' declared SLOs when none is passed.
+        self._slo_model: Dict[str, str] = {}
+        for cfg in self.models.values():
+            for s in cfg.slos:
+                self._slo_model[s.name] = cfg.name
+        if slo is None and self._slo_model:
+            from analytics_zoo_tpu.obs.slo import SloEvaluator
+
+            all_slos = [s for cfg in self.models.values()
+                        for s in cfg.slos]
+            slo = SloEvaluator(slos=all_slos,
+                               registry=self.metrics.registry,
+                               **(slo_params or {}))
+        self.slo = slo
+        self.autoscaler = autoscaler
+        if autoscaler is not None and autoscaler.registry is None:
+            autoscaler.registry = self.metrics.registry
         self.requests: List[Request] = []      # every request ever submitted
         self._rid = itertools.count()
         self._spans: Dict[int, Dict[str, Any]] = {}   # rid -> open spans
         self._window_shed = 0
+        self._window_shed_by: Dict[str, int] = {}
         self._dispatch_idx = 0                 # chaos serving-fault index
         self._since_decision = 0
+        # incremental accounting (exact at any retention mode): every
+        # terminal transition flows through the runtime, so the counters
+        # stay correct when retain_requests=False drops the objects
+        self._submitted = 0
+        self._by_state: Dict[str, int] = {}
+        # streaming sessions: sid -> {model, replica, open, chunks} for
+        # LIVE sessions only — entries are released when the final
+        # chunk reaches a terminal state (or the session is killed), so
+        # session bookkeeping stays O(active sessions), not O(ever
+        # opened); aggregate history lives in the int counters below
+        self._sessions: Dict[int, Dict[str, Any]] = {}
+        self._next_sid = 0
+        self._sessions_opened = 0
+        self._sessions_failed = 0
+        self._open_sessions = 0
+        #: open/in-flight session count per replica rid — the
+        #: open_session placement input and the shrink-protection set
+        self._session_load: Dict[int, int] = {}
 
         self.queue = AdmissionQueue(queue_capacity, self.clock,
                                     on_shed=self._on_shed,
                                     shed_expired=shed_expired)
-        self.batcher = DeadlineBatcher(
-            self.queue, max_batch, bucket_edges=bucket_edges,
-            pad_key=pad_key, length_key=length_key,
-            service_time=service_time, slack_margin_s=slack_margin_s)
+        if self._multi:
+            plans = {name: cfg.plan() for name, cfg in self.models.items()}
+            self.batcher = DeadlineBatcher(
+                self.queue, max_batch, service_time=service_time,
+                slack_margin_s=slack_margin_s, plans=plans)
+        else:
+            self.batcher = DeadlineBatcher(
+                self.queue, max_batch, bucket_edges=bucket_edges,
+                pad_key=pad_key, length_key=length_key,
+                service_time=service_time, slack_margin_s=slack_margin_s)
         self._service_time = service_time
         virtual = service_time is not None
 
-        def service_hook(edge, n, tier, rid):
-            return service_time(edge, n, tier)
+        def service_hook(batch: AssembledBatch, rid: int) -> float:
+            if self._multi:
+                return service_time(batch.model, batch.edge,
+                                    batch.n_valid, batch.tier)
+            return service_time(batch.edge, batch.n_valid, batch.tier)
 
-        forward_fns = [t.forward for t in self.tiers]
+        self._service_hook = service_hook if virtual else None
         self.pool = ReplicaPool(
-            [Replica(r, forward_fns, self.clock, wedge_timeout_s,
-                     service_hook=service_hook if virtual else None)
-             for r in range(n_replicas)],
+            [self._make_replica(r) for r in range(n_replicas)],
             self.clock, restart_s=restart_s,
-            observer=self._on_pool_event if obs is not None else None)
-        self.ladder = DegradationLadder(len(self.tiers), ladder_policy)
+            observer=self._on_pool_event,
+            fence_budget_s=fence_budget_s,
+            replica_factory=self._make_replica,
+            prewarm_keys=self._geometry_plan(),
+            compile_s=compile_s)
+        self.ladders: Dict[str, DegradationLadder] = {
+            name: DegradationLadder(
+                len(cfg.tiers), cfg.ladder_policy or ladder_policy)
+            for name, cfg in self.models.items()}
+        #: single-model alias — the PR-5 API surface
+        self.ladder = (self.ladders[DEFAULT_MODEL]
+                       if not self._multi else None)
+
+    # -- construction helpers ------------------------------------------------
+    def _geometry_plan(self) -> List[Tuple[str, Any, int]]:
+        """Every (model, edge, tier) program a replica must hold warm —
+        what pre-warm compiles before a growth replica joins dispatch."""
+        keys: List[Tuple[str, Any, int]] = []
+        for name, cfg in self.models.items():
+            edges = cfg.bucket_edges or [FIXED]
+            for edge in edges:
+                for tier in range(len(cfg.tiers)):
+                    keys.append((name, edge, tier))
+        return keys
+
+    def _make_replica(self, rid: int) -> Replica:
+        """Build one replica (also the pool's growth factory): the
+        per-model tier table, with per-replica tier INSTANCES when a
+        model declares a ``tier_factory`` (streaming session stores
+        live per replica).  Warmth is the POOL's business: replicas
+        built here are fully warm (PR 5 compiles serving programs at
+        startup) and ``resize`` re-marks growth replicas warming/cold."""
+        fwd: Dict[str, List[Callable]] = {}
+        tier_objs: Dict[str, List[ServingTier]] = {}
+        for name, cfg in self.models.items():
+            t = cfg.tier_factory(rid) if cfg.tier_factory else cfg.tiers
+            if len(t) != len(cfg.tiers):
+                raise ValueError(
+                    f"model {name!r}: tier_factory built {len(t)} tiers, "
+                    f"template declares {len(cfg.tiers)}")
+            fwd[name] = [tier.forward for tier in t]
+            tier_objs[name] = list(t)
+        replica = Replica(rid, fwd, self.clock, self.wedge_timeout_s,
+                          service_hook=self._service_hook)
+        replica.tier_objs = tier_objs
+        return replica
 
     # -- telemetry -----------------------------------------------------------
     def _on_pool_event(self, ev: Dict[str, Any]) -> None:
-        """Every pool event (fence / failover / restart) lands in the
-        flight recorder; a FENCE is a terminal condition — it trips the
-        black-box dump when one is armed."""
+        """Every pool event (fence / failover / restart / resize /
+        cold compile) lands in the flight recorder; a FENCE is a
+        terminal condition — it trips the black-box dump when one is
+        armed.  Cold compiles also count into the registry (the
+        pre-warm drill's tax counter)."""
+        if ev["kind"] == "cold_compile":
+            self.metrics.registry.counter("serve/cold_compiles").inc()
+        if self.obs is None:
+            return
         self.obs.recorder.record(ev)
         if ev["kind"] == "replica_fenced" and self.obs.dump_path:
             self.obs.dump("replica_fenced")
@@ -184,8 +431,16 @@ class ServingRuntime:
 
     # -- shed observer -------------------------------------------------------
     def _on_shed(self, req: Request, cause: str) -> None:
-        self.metrics.on_shed(cause)
+        self.metrics.on_shed(cause, model=req.model if self._multi
+                             else None)
         self._window_shed += 1
+        self._window_shed_by[req.model] = \
+            self._window_shed_by.get(req.model, 0) + 1
+        self._account_terminal(req)
+        if req.session is not None:
+            # a gap in the chunk stream silently corrupts the session's
+            # carry — a shed chunk fails the WHOLE session honestly
+            self._kill_session(req, f"chunk shed ({cause})")
         if self.obs is not None:
             spans = self._spans.pop(req.rid, None)
             if spans is not None:
@@ -194,21 +449,58 @@ class ServingRuntime:
                     q.end(status=cause)
                 spans["root"].end(status=req.state, cause=cause)
 
+    def _account_terminal(self, req: Request) -> None:
+        self._by_state[req.state] = self._by_state.get(req.state, 0) + 1
+
     # -- client API ----------------------------------------------------------
+    def _resolve_model(self, model: Optional[str]) -> ModelConfig:
+        if model is None:
+            if self._multi and len(self.models) > 1:
+                raise ValueError(
+                    f"multiplexed runtime serves "
+                    f"{sorted(self.models)} — submit(model=...) is "
+                    f"required")
+            return next(iter(self.models.values()))
+        try:
+            return self.models[model]
+        except KeyError:
+            raise KeyError(f"unknown model {model!r} (registered: "
+                           f"{sorted(self.models)})") from None
+
     def submit(self, payload: Any, deadline_s: Optional[float] = None,
-               length: Optional[int] = None) -> Request:
+               length: Optional[int] = None,
+               model: Optional[str] = None) -> Request:
         """Admit one request; raises
         :class:`~analytics_zoo_tpu.resilience.errors.ServerOverloaded`
         on a full queue (the request is still accounted, state
         ``shed``).  ``length``: variable-axis length for bucket
-        assignment."""
+        assignment.  ``model``: which multiplexed model (required when
+        the runtime serves more than one)."""
+        cfg = self._resolve_model(model)
+        if cfg.streaming:
+            raise ValueError(
+                f"model {cfg.name!r} is a streaming session model — use "
+                f"open_session()/submit_chunk()")
+        if deadline_s is None:
+            deadline_s = (cfg.default_deadline_s
+                          if cfg.default_deadline_s is not None
+                          else self.default_deadline_s)
+        return self._submit(payload, deadline_s, length, cfg.name)
+
+    def _submit(self, payload: Any, deadline_s: float,
+                length: Optional[int], model: str,
+                session: Optional[int] = None,
+                affinity: Optional[int] = None,
+                final: bool = False) -> Request:
         now = self.clock.now()
         req = Request(rid=next(self._rid), payload=payload, arrival_t=now,
-                      deadline_t=now + (deadline_s if deadline_s is not None
-                                        else self.default_deadline_s),
-                      length=length)
-        self.requests.append(req)
-        self.metrics.on_submit()
+                      deadline_t=now + deadline_s, length=length,
+                      model=model, session=session, affinity=affinity,
+                      final=final)
+        self._submitted += 1
+        if self.retain_requests:
+            self.requests.append(req)
+        self.metrics.on_submit(model=model if self._multi else None)
         if self.obs is not None:
             # root span of this request's trace: opened here, closed at
             # whatever terminal state the request reaches
@@ -223,7 +515,187 @@ class ServingRuntime:
                 "queue", spans["root"].trace_id, parent=spans["root"])
         return req
 
+    # -- streaming sessions --------------------------------------------------
+    def open_session(self, model: Optional[str] = None) -> int:
+        """Open a streaming session on its least-loaded healthy replica
+        (session-affine: every chunk of this session dispatches THERE —
+        the model's carry state lives on that replica).  Raises
+        :class:`ServerOverloaded` when no replica is dispatchable."""
+        cfg = self._resolve_model(model)
+        if not cfg.streaming:
+            raise ValueError(f"model {cfg.name!r} is not a streaming "
+                             f"session model")
+        healthy = self.pool.healthy()
+        if not healthy:
+            raise ServerOverloaded("no healthy replica to pin a "
+                                   "session to; retry with backoff")
+        rid = min((r.rid for r in healthy),
+                  key=lambda r: (self._session_load.get(r, 0), r))
+        sid = self._next_sid
+        self._next_sid += 1
+        self._sessions[sid] = {"model": cfg.name, "replica": rid,
+                               "open": True, "chunks": 0}
+        self._sessions_opened += 1
+        self._open_sessions += 1
+        self._session_load[rid] = self._session_load.get(rid, 0) + 1
+        self.metrics.registry.counter("serve/sessions/opened").inc()
+        self.metrics.registry.gauge("serve/sessions_open").set(
+            float(self._open_sessions))
+        if self.obs is not None:
+            self.obs.recorder.note("session_opened", session=sid,
+                                   model=cfg.name, replica=rid,
+                                   t=round(self.clock.now(), 6))
+        return sid
+
+    def submit_chunk(self, sid: int, payload: Any,
+                     length: Optional[int] = None,
+                     deadline_s: Optional[float] = None,
+                     final: bool = False) -> Request:
+        """Feed one chunk of an open session.  The chunk's deadline is
+        INCREMENTAL — anchored at this submit instant (``deadline_s`` or
+        the model's ``chunk_deadline_s``), so a long-lived stream never
+        accumulates slack debt and chunk deadlines stay monotone — EDF
+        therefore preserves chunk order within the session's single
+        (model, affinity, edge) group (``ModelConfig`` rejects
+        multi-edge streaming plans for exactly this reason).
+        ``final=True`` flushes the
+        session (the stateful forward emits the tail) and closes it on
+        successful admission — a final chunk shed at the door kills the
+        session instead (the flush tail is unrecoverable)."""
+        sess = self._sessions.get(sid)
+        if sess is None:
+            if 0 <= sid < self._next_sid:
+                raise RuntimeError(f"session {sid} is closed")
+            raise KeyError(f"unknown session {sid}")
+        if not sess["open"]:
+            raise RuntimeError(f"session {sid} is closed")
+        cfg = self.models[sess["model"]]
+        if deadline_s is None:
+            deadline_s = cfg.chunk_deadline_s
+        # chunk deadlines must stay MONOTONE within the session — EDF
+        # order IS chunk order, so a custom deadline_s earlier than a
+        # previous chunk's would reorder the decode; clamp up to the
+        # session's deadline high-water mark
+        now = self.clock.now()
+        deadline_s = max(deadline_s,
+                         sess.get("last_deadline_t", 0.0) - now)
+        # submit FIRST: a queue-full shed routes through _on_shed which
+        # kills the session (a gap in the chunk stream would silently
+        # corrupt the carry); only a successfully admitted final chunk
+        # marks the session closed
+        req = self._submit(
+            payload, deadline_s, length, cfg.name, session=sid,
+            affinity=sess["replica"], final=final)
+        sess["chunks"] += 1
+        sess["last_deadline_t"] = req.deadline_t
+        if final:
+            self._close_session_books(sess)
+        return req
+
+    def close_session(self, sid: int) -> None:
+        """Client-initiated abort of an open session WITHOUT a flush
+        chunk (the stream was abandoned): books close, the live entry
+        and its replica pin release, and the pinned replica's store
+        entry is evicted — so an abandoned session doesn't hold its
+        replica hostage against autoscaler shrink or leak carry state.
+        (An idle-session TTL that does this automatically is ROADMAP
+        item-1 follow-up work; until then abandonment is the caller's
+        contract.)  No-op if the session is already closed/released."""
+        sess = self._sessions.get(sid)
+        if sess is None:
+            return
+        self._close_session_books(sess)
+        replica = self.pool.replica_by_rid(sess["replica"])
+        self._release_session(sid)
+        if replica is not None:
+            for tier in replica.tier_objs.get(sess["model"], []):
+                if tier.evict_session is not None:
+                    tier.evict_session(sid)
+        if self.obs is not None:
+            self.obs.recorder.note("session_closed", session=sid,
+                                   t=round(self.clock.now(), 6))
+
+    def _close_session_books(self, sess: Dict[str, Any]) -> None:
+        if not sess["open"]:
+            return
+        sess["open"] = False
+        self._open_sessions -= 1
+        self.metrics.registry.counter("serve/sessions/closed").inc()
+        self.metrics.registry.gauge("serve/sessions_open").set(
+            float(self._open_sessions))
+
+    def _session_rids(self) -> Set[int]:
+        """Replicas pinned by sessions with work outstanding (open, or
+        closed with the final chunk still in flight) — protected from
+        the autoscaler's drain-then-retire."""
+        return {rid for rid, n in self._session_load.items() if n > 0}
+
+    def _release_session(self, sid: int) -> None:
+        """The session's last outcome landed (final chunk terminal, or
+        killed): drop the live entry and its replica pin."""
+        sess = self._sessions.pop(sid, None)
+        if sess is None:
+            return
+        rid = sess["replica"]
+        n = self._session_load.get(rid, 0) - 1
+        if n > 0:
+            self._session_load[rid] = n
+        else:
+            self._session_load.pop(rid, None)
+
+    def _kill_session(self, req: Request, reason: str) -> None:
+        """A chunk died without being served (shed, dispatch failure,
+        replica loss): the session's carry now has a gap, so the whole
+        session fails honestly — books closed, live entry released, and
+        the pinned replica's store entry evicted
+        (``ServingTier.evict_session``) so dead sessions don't leak
+        state.  Chunks of this session still queued are failed before
+        their dispatch (``_scrub_dead_session_rows``) — they never
+        serve from recreated-empty state."""
+        sid = req.session
+        sess = self._sessions.get(sid)
+        if sess is not None:
+            self._close_session_books(sess)
+            self._release_session(sid)
+            self._sessions_failed += 1
+            replica = self.pool.replica_by_rid(req.affinity) \
+                if req.affinity is not None else None
+            if replica is not None:
+                for tier in replica.tier_objs.get(req.model, []):
+                    if tier.evict_session is not None:
+                        tier.evict_session(sid)
+            if self.obs is not None:
+                self.obs.recorder.note("session_failed", session=sid,
+                                       reason=reason[:160],
+                                       t=round(self.clock.now(), 6))
+
+    def _scrub_dead_session_rows(self, batch: AssembledBatch) -> None:
+        """A killed session's chunks may still be queued (admitted
+        before the kill): fail them BEFORE the forward and mask their
+        rows (session −1, final 0), so they neither return garbage
+        marked ``done`` nor recreate the evicted store entry on the
+        replica."""
+        if batch.affinity is None:
+            return
+        for i, req in enumerate(batch.requests):
+            if req.session is None or req.session in self._sessions:
+                continue
+            req.finish("failed", self.clock.now(), error=ReplicaWedged(
+                f"session {req.session} already failed"))
+            self._account_terminal(req)
+            self.metrics.on_fail(model=batch.model if self._multi
+                                 else None)
+            self._end_request_spans(req, "failed", attempts=req.attempts)
+            batch.batch["session"][i] = -1
+            batch.batch["final"][i] = 0
+
     # -- scheduler -----------------------------------------------------------
+    def _tier_arg(self):
+        if self._multi:
+            return {name: ladder.tier
+                    for name, ladder in self.ladders.items()}
+        return self.ladder.tier
+
     def pump(self, force: bool = False) -> int:
         """Run all currently due scheduling work: shed expired requests,
         assemble and dispatch every flush-ready batch.  Returns the
@@ -231,7 +703,14 @@ class ServingRuntime:
         advancing the clock."""
         dispatched = 0
         while True:
-            batch = self.batcher.next_batch(self.ladder.tier, force=force)
+            if self.parallel and not force \
+                    and not self.pool.any_free(self.clock.now()):
+                # every replica is serving concurrently — assembling a
+                # batch now would only burn its members' slack; expiry
+                # still ran on the previous iteration's next_batch
+                self.queue.expire()
+                break
+            batch = self.batcher.next_batch(self._tier_arg(), force=force)
             if batch is None:
                 # no batch is flush-ready; expiry may still have shed —
                 # that counts toward the current decision window
@@ -240,7 +719,14 @@ class ServingRuntime:
             dispatched += 1
         return dispatched
 
-    def drain(self, max_batches: int = 10_000) -> None:
+    def next_event_t(self) -> Optional[float]:
+        """Parallel mode: the next virtual instant the pool changes
+        state (a replica frees / restarts / finishes pre-warming) — an
+        event-driven load loop advances the clock to ``min(this, next
+        arrival)`` when :meth:`pump` has nothing to do."""
+        return self.pool.next_event_t(self.clock.now())
+
+    def drain(self, max_batches: int = 10_000_000) -> None:
         """Force-flush everything still queued (shutdown / end of drill):
         every pending request reaches a terminal state."""
         for _ in range(max_batches):
@@ -261,7 +747,11 @@ class ServingRuntime:
                 "replica", replica.rid) == replica.rid:
             self.chaos.serving_active("slow_forward", idx)  # record+consume
             delay = float(spec.detail.get("delay_s", 2.0))
-            hooks.append(lambda r: self.clock.sleep(delay))
+            # the wedge advances time THROUGH the replica's budget guard:
+            # with a fence budget armed the pool observes the wedge at
+            # the fence instant; without one this is a plain sleep (the
+            # PR-5 return-then-check path, byte-identical)
+            hooks.append(lambda r: r.sleep_guarded(delay))
         spec = self.chaos.serving_active("replica_crash", idx, consume=False)
         if spec is not None and spec.detail.get(
                 "replica", replica.rid) == replica.rid:
@@ -284,9 +774,15 @@ class ServingRuntime:
         return fault
 
     def _dispatch(self, batch: AssembledBatch) -> None:
+        self._scrub_dead_session_rows(batch)
+        if self.parallel:
+            self._dispatch_parallel(batch)
+            return
         self._dispatch_idx += 1
-        self.metrics.on_batch(batch.n_valid, self.max_batch,
+        self.metrics.on_batch(batch.n_valid,
+                              self.batcher.model_batch(batch.model),
                               self.queue.depth)
+        model_label = batch.model if self._multi else None
         t0 = self.clock.now()
         batch_span = None
         if self.obs is not None:
@@ -314,10 +810,17 @@ class ServingRuntime:
         except ReplicaWedged as err:
             now = self.clock.now()
             for req in batch.requests:
+                if req.finished:        # scrubbed dead-session row
+                    continue
                 req.finish("failed", now, error=err)
-                self.metrics.on_fail()
+                self._account_terminal(req)
+                self.metrics.on_fail(model=model_label)
                 self._end_request_spans(req, "failed",
                                         attempts=req.attempts)
+                if req.session is not None:
+                    # affine dispatch lost its replica (or wedged): the
+                    # session's carry state is gone — honest state loss
+                    self._kill_session(req, str(err))
             if batch_span is not None:
                 batch_span.end(status="failed",
                                redispatched=batch.redispatched)
@@ -326,22 +829,113 @@ class ServingRuntime:
         now = self.clock.now()
         rows = np.asarray(out)
         for i, req in enumerate(batch.requests):
+            if req.finished:            # scrubbed dead-session row
+                continue
             req.tier = batch.tier
-            req.finish("done", now, result=rows[i])
+            req.finish("done", now,
+                       result=rows[i] if self.retain_requests else None)
+            self._account_terminal(req)
             missed = now > req.deadline_t
             self.metrics.on_complete(now - req.arrival_t, batch.tier,
-                                     missed=missed)
+                                     missed=missed, model=model_label)
             self._end_request_spans(req, "done", attempts=req.attempts,
                                     missed=missed)
+            if req.final and req.session is not None:
+                self._release_session(req.session)
         if batch_span is not None:
             batch_span.end(status="done", redispatched=batch.redispatched)
         self._after_dispatch(batch, t0, failed=False)
 
+    def _dispatch_parallel(self, batch: AssembledBatch) -> None:
+        """Parallel-service dispatch: assign the batch to a free (or,
+        for sessions/force-drain, the pinned/least-busy) replica; its
+        completion lands at ``start + cold_tax + service`` on THAT
+        replica's busy horizon while the shared clock stands still —
+        replicas serve concurrently, so resizing the pool really
+        changes capacity (what the fleet drill measures)."""
+        self._dispatch_idx += 1
+        self.metrics.on_batch(batch.n_valid,
+                              self.batcher.model_batch(batch.model),
+                              self.queue.depth)
+        now = self.clock.now()
+        model_label = batch.model if self._multi else None
+        if batch.affinity is not None:
+            self.pool._revive()
+            replica = self.pool.replica_by_rid(batch.affinity)
+            if replica is None or replica.state != "healthy":
+                replica = None
+        else:
+            replica = self.pool.pick_free(now)
+            if replica is None:
+                # force-drain path: queue the batch on the least-busy
+                # replica (starts when it frees)
+                replica = self.pool.least_busy()
+        def fail_batch(err: BaseException) -> None:
+            for req in batch.requests:
+                if req.finished:        # scrubbed dead-session row
+                    continue
+                req.finish("failed", now, error=err)
+                self._account_terminal(req)
+                self.metrics.on_fail(model=model_label)
+                if req.session is not None:
+                    self._kill_session(req, str(err))
+            self._since_decision += 1
+            if self._since_decision >= self.decision_every:
+                self._decide_window()
+
+        if replica is None:
+            fail_batch(ReplicaWedged(
+                f"no replica available for model {batch.model!r}"
+                + (f" (session pinned to {batch.affinity})"
+                   if batch.affinity is not None else "")))
+            return
+        # run the real forward BEFORE committing the busy horizon: a
+        # crashing forward fails its requests outright without charging
+        # the replica for service it never rendered.  NOTE: unlike the
+        # serial path, parallel mode has NO failover redispatch — the
+        # fence/retry story lives in serial mode (chaos drills); chaos
+        # + failover under the parallel service model is ROADMAP
+        # item-1 follow-up work
+        try:
+            out = replica._fn_for(batch)(batch.batch)
+        except Exception as err:
+            fail_batch(err if isinstance(err, ReplicaWedged)
+                       else ReplicaWedged(
+                           f"replica {replica.rid}: forward crashed "
+                           f"mid-batch ({type(err).__name__}: {err})"))
+            return
+        start = max(now, replica.busy_until)
+        tax = replica.cold_tax(batch)
+        service = float(self._service_hook(batch, replica.rid))
+        completion = start + tax + service
+        replica.busy_until = completion
+        replica.dispatches += 1
+        rows = np.asarray(out)
+        for i, req in enumerate(batch.requests):
+            if req.finished:            # scrubbed dead-session row
+                continue
+            req.tier = batch.tier
+            req.finish("done", completion,
+                       result=rows[i] if self.retain_requests else None)
+            self._account_terminal(req)
+            missed = completion > req.deadline_t
+            self.metrics.on_complete(completion - req.arrival_t,
+                                     batch.tier, missed=missed,
+                                     model=model_label)
+            if req.final and req.session is not None:
+                self._release_session(req.session)
+        self._since_decision += 1
+        if self._since_decision >= self.decision_every:
+            self._decide_window()
+
     def _after_dispatch(self, batch: AssembledBatch, t0: float,
                         failed: bool) -> None:
         dt = self.clock.now() - t0
-        if not failed:
-            self.batcher.observe_service_s(batch.edge, dt, tier=batch.tier)
+        if not failed and self.batcher.service_time is None:
+            # the EWMA is only ever read when no explicit service model
+            # is configured — don't maintain it for nobody
+            self.batcher.observe_service_s(batch.edge, dt, tier=batch.tier,
+                                           model=batch.model)
         if batch.redispatched:
             self.metrics.redispatches += 1
         self._since_decision += 1
@@ -367,28 +961,102 @@ class ServingRuntime:
                     new_trips=list(decision.new_trips),
                     recovered=list(decision.recovered),
                     scale_hint=decision.scale_hint)
-            self.ladder.observe_decision(decision, detail=detail)
+            if self._multi:
+                self._observe_multi(decision, detail)
+            else:
+                self.ladder.observe_decision(decision, detail=detail)
+            if self.autoscaler is not None:
+                self._actuate(decision)
         else:
-            depth_high = self.ladder.policy.depth_high * self.max_batch
-            overloaded = (self._window_shed > 0
-                          or self.queue.depth > depth_high)
-            self.ladder.observe_window(overloaded, detail=detail)
+            if self._multi:
+                for name, ladder in self.ladders.items():
+                    depth_high = ladder.policy.depth_high * self.max_batch
+                    overloaded = (
+                        self._window_shed_by.get(name, 0) > 0
+                        or self.queue.depth > depth_high)
+                    ladder.observe_window(overloaded, detail=dict(detail))
+            else:
+                depth_high = self.ladder.policy.depth_high * self.max_batch
+                overloaded = (self._window_shed > 0
+                              or self.queue.depth > depth_high)
+                self.ladder.observe_window(overloaded, detail=detail)
         self._window_shed = 0
+        self._window_shed_by = {}
         self._since_decision = 0
+
+    def _observe_multi(self, decision, detail: Dict[str, Any]) -> None:
+        """Fan one SLO decision out to the per-model ladders and refresh
+        the weighted-EDF weights: each model's ladder sees only ITS
+        SLOs' burn, and its dispatch weight follows its worst
+        fast-window burn (capped) — deadline weighted by how fast that
+        model's error budget is being spent."""
+        burning_by_model: Dict[str, List[str]] = {}
+        for slo_name in decision.burning:
+            m = self._slo_model.get(slo_name)
+            if m is not None:
+                burning_by_model.setdefault(m, []).append(slo_name)
+        for name, ladder in self.ladders.items():
+            cfg = self.models[name]
+            if cfg.slos:
+                burning = burning_by_model.get(name, [])
+                d = {"slo_burning": burning,
+                     "scale_hint": decision.scale_hint, **detail}
+                ladder.observe_window(bool(burning), detail=d)
+            else:
+                # a model with no declared SLOs falls back to its raw
+                # per-model shed flag
+                ladder.observe_window(
+                    self._window_shed_by.get(name, 0) > 0,
+                    detail=dict(detail))
+            if cfg.slos:
+                worst = max((decision.per_slo[s.name]["fast"]["burn"]
+                             for s in cfg.slos
+                             if s.name in decision.per_slo),
+                            default=0.0)
+                w = min(max(1.0, 1.0 + worst), self.weight_cap)
+                self.batcher.set_model_weight(name, w)
+                self.metrics.registry.gauge(
+                    f"serve/model_weight/model={name}").set(w)
+
+    def _actuate(self, decision) -> None:
+        """The autoscaler's policy loop, then the ACTUATION: a due
+        target resizes the pool — growth pre-warms compiled geometries
+        before the replica joins dispatch, shrink drains-then-retires
+        (session-pinned replicas protected)."""
+        target = self.autoscaler.observe_decision(decision,
+                                                  self.pool.size)
+        if target is None:
+            return
+        actions = self.pool.resize(target,
+                                   prewarm=self.autoscaler.policy.prewarm,
+                                   protected=sorted(self._session_rids()))
+        if self.obs is not None:
+            self.obs.recorder.note(
+                "autoscale", t=round(self.clock.now(), 6),
+                target=target, grown=actions["grown"],
+                drained=actions["drained"],
+                burning=list(decision.burning))
 
     # -- observability -------------------------------------------------------
     def accounting(self) -> Dict[str, Any]:
         """Request-conservation check: every submitted request is in
         exactly one terminal state once the runtime is drained —
-        ``unaccounted == 0`` is the drill's hard invariant."""
-        by_state: Dict[str, int] = {}
-        for r in self.requests:
-            by_state[r.state] = by_state.get(r.state, 0) + 1
+        ``unaccounted == 0`` is the drill's hard invariant.  Exact in
+        both retention modes: with ``retain_requests`` the states are
+        recounted from the objects; without, the incrementally
+        maintained terminal counters ARE the ledger (every terminal
+        transition flows through the runtime)."""
+        if self.retain_requests:
+            by_state: Dict[str, int] = {}
+            for r in self.requests:
+                by_state[r.state] = by_state.get(r.state, 0) + 1
+        else:
+            by_state = dict(sorted(self._by_state.items()))
         terminal = sum(v for k, v in by_state.items()
                        if k in ("done", "shed", "timeout", "failed"))
-        return {"submitted": len(self.requests), "by_state": by_state,
+        return {"submitted": self._submitted, "by_state": by_state,
                 "terminal": terminal,
-                "unaccounted": len(self.requests) - terminal}
+                "unaccounted": self._submitted - terminal}
 
     def snapshot(self) -> Dict[str, Any]:
         mesh_info = None
@@ -402,12 +1070,32 @@ class ServingRuntime:
             "metrics": self.metrics.snapshot(),
             "queue": self.queue.snapshot(),
             "replicas": self.pool.snapshot(),
-            "ladder": self.ladder.snapshot(),
-            "tiers": [{"name": t.name, "speed": t.speed,
-                       "quality_note": t.quality_note}
-                      for t in self.tiers],
             "accounting": self.accounting(),
         }
+        if self._multi:
+            out["models"] = {
+                name: {
+                    "ladder": self.ladders[name].snapshot(),
+                    "weight": self.batcher.model_weight(name),
+                    "outcomes": self.metrics.model_snapshot(name),
+                    "tiers": [{"name": t.name, "speed": t.speed}
+                              for t in cfg.tiers],
+                }
+                for name, cfg in self.models.items()}
+            out["sessions"] = {
+                "opened": self._sessions_opened,
+                "open": self._open_sessions,
+                "failed": self._sessions_failed,
+            }
+            if self.autoscaler is not None:
+                out["autoscale"] = self.autoscaler.snapshot()
+                out["pool_size"] = self.pool.size
+                out["cold_compiles"] = self.pool.cold_compiles
+        else:
+            out["ladder"] = self.ladder.snapshot()
+            out["tiers"] = [{"name": t.name, "speed": t.speed,
+                             "quality_note": t.quality_note}
+                            for t in self.tiers]
         if self.slo is not None:
             # keyed in only when armed, so pre-PR-11 snapshots (and the
             # banked RESILIENCE_r03/OBS_r01 replays) are byte-unchanged

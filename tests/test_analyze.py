@@ -556,17 +556,27 @@ class TestRepoClean:
                    for n in names)
         assert any(n.startswith("ds2/serve:beam") for n in names)
         assert "ds2/serve:greedy" in names
+        # ISSUE 14: the multiplexed fleet's per-model serving programs
+        # — frcnn + fraud joined the rung factories, and the streaming
+        # DS2 session model exposes its carry-in/carry-out steady-block
+        # program — all audited like every other rung
+        assert {"frcnn/serve:fp", "frcnn/serve:int8"} <= names
+        assert {"fraud/serve:fp", "fraud/serve:int8"} <= names
+        assert "ds2-stream/serve:stream" in names
 
     def test_serving_tiers_expose_device_programs(self):
         """Every ladder rung the factories hand the runtime must carry
         its audit hook — a tier without one degrades the program audit
         silently."""
-        from analytics_zoo_tpu.analysis.targets import (_ds2_serving,
-                                                        _ssd_serving)
+        from analytics_zoo_tpu.analysis.targets import (
+            _ds2_serving, _ds2_streaming_serving, _fraud_serving,
+            _frcnn_serving, _ssd_serving)
         from analytics_zoo_tpu.parallel import mesh as mesh_lib
 
         mesh = mesh_lib.create_mesh()
-        for target in _ssd_serving(mesh) + _ds2_serving(mesh):
+        for target in (_ssd_serving(mesh) + _ds2_serving(mesh)
+                       + _ds2_streaming_serving(mesh)
+                       + _frcnn_serving(mesh) + _fraud_serving(mesh)):
             built = target.build()      # raises if the hook is missing
             assert callable(built.fn)
 

@@ -1,0 +1,731 @@
+"""Fleet control plane (ISSUE 14) — tier-1 virtual-clock smoke.
+
+The closed-loop autoscaler's policy discipline on hand-fed decision
+streams (grow on fast+slow burn, hold on fast-only, shrink only after
+the clean-window hysteresis), the actuator semantics (drain-then-retire
+conservation, pre-warm before dispatch eligibility, the cold-compile
+tax), the fence-budget bound on wedge redispatch (the OBS_r02 p99 fix),
+and the multiplexing core: per-(model, edge, tier) EWMA cold-start
+isolation, models never sharing a batch, weighted-EDF dispatch order,
+and session-affine streaming scheduling.  Everything runs on the
+VirtualClock in milliseconds of real CPU — the full-size version is the
+banked SERVING_SCALE_r01.json fleet drill.
+"""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.obs.registry import MetricRegistry
+from analytics_zoo_tpu.obs.slo import SLO, SloEvaluator, model_slos
+from analytics_zoo_tpu.resilience.chaos import ChaosMonkey, FaultSpec
+from analytics_zoo_tpu.serving import (Autoscaler, AutoscalePolicy,
+                                       DeadlineBatcher, ModelConfig,
+                                       ModelPlan, Replica, ReplicaPool,
+                                       Request, ServingRuntime,
+                                       ServingTier, VirtualClock)
+from analytics_zoo_tpu.serving.request import AdmissionQueue
+
+
+def _fwd(batch):
+    x = batch["input"]
+    return x.reshape(x.shape[0], -1).sum(axis=1)
+
+
+# ---------------------------------------------------------------------------
+# The policy loop (pure: hand-fed hints / decisions / snapshots)
+# ---------------------------------------------------------------------------
+
+
+class TestAutoscalePolicy:
+    def test_grow_on_burning_hint_with_streak_and_cooldown(self):
+        sc = Autoscaler(AutoscalePolicy(min_replicas=1, max_replicas=4,
+                                        grow_after=2, shrink_after=3,
+                                        cooldown=2))
+        assert sc.observe_hint(1, 2) is None          # streak 1 of 2
+        assert sc.observe_hint(1, 2) == 3             # grow
+        # cooldown: the next two burning decisions are ignored
+        assert sc.observe_hint(1, 3) is None
+        assert sc.observe_hint(1, 3) is None
+        # then a fresh streak is required again
+        assert sc.observe_hint(1, 3) is None
+        assert sc.observe_hint(1, 3) == 4
+        # at the bound: no actuation
+        sc2 = Autoscaler(AutoscalePolicy(max_replicas=4, grow_after=1,
+                                         cooldown=0))
+        assert sc2.observe_hint(1, 4) is None
+
+    def test_shrink_needs_full_clean_streak_mirroring_ladder(self):
+        sc = Autoscaler(AutoscalePolicy(min_replicas=1, max_replicas=8,
+                                        grow_after=1, shrink_after=3,
+                                        cooldown=0))
+        assert sc.observe_hint(-1, 4) is None
+        assert sc.observe_hint(-1, 4) is None
+        # a hold (unconfirmed burn / mixed signals) resets the streak —
+        # the ladder's promote-after-M-clean discipline
+        assert sc.observe_hint(0, 4) is None
+        assert sc.observe_hint(-1, 4) is None
+        assert sc.observe_hint(-1, 4) is None
+        assert sc.observe_hint(-1, 4) == 3
+        # min bound
+        sc2 = Autoscaler(AutoscalePolicy(min_replicas=2, shrink_after=1,
+                                         cooldown=0))
+        assert sc2.observe_hint(-1, 2) is None
+
+    def test_grow_on_fast_plus_slow_burn_hold_on_fast_only(self):
+        """The multi-window discipline end-to-end: an SLO burning on
+        BOTH windows grows; a fast-window-only spike HOLDS (hint 0 —
+        both streaks reset)."""
+        slo = SLO(name="miss", kind="ratio", budget=0.1,
+                  bad=("bad",), total=("total",))
+        ev = SloEvaluator([slo], fast_window_s=10.0, slow_window_s=100.0,
+                          time_scale=1.0)
+        # min_replicas pins the floor: the idle history legitimately
+        # hints -1, which must not actuate below the current size
+        sc = Autoscaler(AutoscalePolicy(min_replicas=2, grow_after=1,
+                                        cooldown=0, max_replicas=8))
+        # long clean history fills the slow window with near-zero burn
+        bad, total = 0, 0
+        for t in range(0, 95, 5):
+            total += 50
+            ev.observe({"counters": {"bad": bad, "total": total}}, float(t))
+            d = ev.decide(float(t))
+            assert sc.observe_decision(d, 2) is None
+        # a fast spike: fast burn >> 2x, slow window still diluted
+        bad += 25
+        total += 50
+        ev.observe({"counters": {"bad": bad, "total": total}}, 100.0)
+        d = ev.decide(100.0)
+        assert d.per_slo["miss"]["fast"]["burn"] >= 2.0
+        assert d.per_slo["miss"]["slow"]["burn"] < 1.0
+        assert d.scale_hint == 0 and not d.burning
+        assert sc.observe_decision(d, 2) is None      # hold, not grow
+        # sustained: the slow window confirms -> burning -> grow
+        for t in range(105, 160, 5):
+            bad += 25
+            total += 50
+            ev.observe({"counters": {"bad": bad, "total": total}},
+                       float(t))
+            d = ev.decide(float(t))
+            if d.burning:
+                assert d.scale_hint == 1
+                assert sc.observe_decision(d, 2) == 3
+                break
+        else:
+            pytest.fail("sustained burn never confirmed on both windows")
+
+    def test_snapshot_only_observer_reads_mirrored_gauges(self):
+        """The PR-11 promise: an autoscaler holding only registry
+        snapshots (slo/*_burn gauges) reconstructs the hint."""
+        sc = Autoscaler(AutoscalePolicy(grow_after=1, shrink_after=2,
+                                        cooldown=0, max_replicas=4))
+        burn = {"gauges": {"slo/fast_burn/slo=miss": 3.0,
+                           "slo/slow_burn/slo=miss": 1.5}}
+        assert sc.observe_registry(burn, 2, t=0.0) == 3
+        idle = {"gauges": {"slo/fast_burn/slo=miss": 0.1,
+                           "slo/slow_burn/slo=miss": 0.2}}
+        assert sc.observe_registry(idle, 3, t=1.0) is None
+        assert sc.observe_registry(idle, 3, t=2.0) == 2
+        mixed = {"gauges": {"slo/fast_burn/slo=miss": 3.0,
+                            "slo/slow_burn/slo=miss": 0.2}}
+        assert sc.observe_registry(mixed, 2, t=3.0) is None  # fast-only
+
+    def test_registry_export_counts_actions(self):
+        reg = MetricRegistry()
+        sc = Autoscaler(AutoscalePolicy(grow_after=1, shrink_after=1,
+                                        cooldown=0, max_replicas=4),
+                        registry=reg)
+        sc.observe_hint(1, 2)
+        sc.observe_hint(-1, 3)
+        assert reg.counter("autoscale/grow").value == 1
+        assert reg.counter("autoscale/shrink").value == 1
+        assert reg.gauge("autoscale/replicas").value == 2.0
+
+
+# ---------------------------------------------------------------------------
+# The actuator: resize on a live pool
+# ---------------------------------------------------------------------------
+
+
+def _pool(clock, n=2, compile_s=0.0, prewarm_keys=(), service=0.05):
+    def factory(rid):
+        return Replica(rid, [_fwd, _fwd], clock, wedge_timeout_s=60.0,
+                       service_hook=lambda batch, r: service)
+
+    replicas = [factory(r) for r in range(n)]
+    if compile_s > 0:
+        for r in replicas:
+            r.warm_keys = set(prewarm_keys)
+            r.compile_s = compile_s
+    return ReplicaPool(replicas, clock, restart_s=1.0,
+                       replica_factory=factory,
+                       prewarm_keys=prewarm_keys, compile_s=compile_s)
+
+
+def _batch(reqs=None, model="default", edge="fixed", tier=0):
+    from analytics_zoo_tpu.serving.batcher import AssembledBatch
+
+    return AssembledBatch(
+        requests=reqs or [], batch={"input": np.ones((1, 2), np.float32)},
+        edge=edge, n_valid=1, tier=tier, model=model)
+
+
+class TestResizeActuator:
+    def test_prewarm_runs_before_dispatch_eligibility(self):
+        """A prewarmed growth replica is NOT dispatchable while its
+        geometries compile; it joins with every planned key warm and
+        never pays a cold compile."""
+        clock = VirtualClock()
+        keys = [("default", "fixed", 0), ("default", "fixed", 1)]
+        pool = _pool(clock, n=1, compile_s=2.0, prewarm_keys=keys)
+        actions = pool.resize(2, prewarm=True)
+        assert actions["grown"] == [1]
+        r = pool.replica_by_rid(1)
+        assert r.state == "warming"
+        assert [x.rid for x in pool.healthy()] == [0]   # not eligible
+        clock.advance(2.0 * len(keys) - 0.5)
+        assert [x.rid for x in pool.healthy()] == [0]   # still compiling
+        clock.advance(0.5)
+        assert {x.rid for x in pool.healthy()} == {0, 1}
+        assert r.warm_keys == set(keys)
+        assert [e["kind"] for e in pool.events] == [
+            "replica_joined", "replica_prewarmed"]
+        # a warm dispatch pays no tax
+        t0 = clock.now()
+        r.forward(_batch(tier=1))
+        assert r.cold_compiles == 0
+        assert clock.now() - t0 == pytest.approx(0.05)
+
+    def test_cold_join_pays_the_compile_tax_per_geometry(self):
+        clock = VirtualClock()
+        keys = [("default", "fixed", 0), ("default", "fixed", 1)]
+        pool = _pool(clock, n=1, compile_s=2.0, prewarm_keys=keys)
+        pool.resize(2, prewarm=False)
+        r = pool.replica_by_rid(1)
+        assert r.state == "healthy" and r.warm_keys == set()
+        t0 = clock.now()
+        r.forward(_batch(tier=0))
+        assert clock.now() - t0 == pytest.approx(2.0 + 0.05)  # tax + serve
+        t1 = clock.now()
+        r.forward(_batch(tier=0))                   # now warm: no tax
+        assert clock.now() - t1 == pytest.approx(0.05)
+        r.forward(_batch(tier=1))                   # other tier: cold again
+        assert r.cold_compiles == 2
+        assert sum(e["kind"] == "cold_compile" for e in pool.events) == 2
+
+    def test_drain_then_retire_accounts_every_request(self):
+        """Shrink mid-load: the drained replica takes no new batches,
+        every queued request still completes, and the victim retires
+        only once idle — conservation through the actuation."""
+        clock = VirtualClock()
+        rt = ServingRuntime(
+            [ServingTier("fp", _fwd)], n_replicas=3, clock=clock,
+            queue_capacity=64, max_batch=2, default_deadline_s=30.0,
+            wedge_timeout_s=60.0,
+            service_time=lambda e, n, t: 0.05)
+        for _ in range(6):
+            rt.submit({"input": np.ones((1, 2), np.float32)})
+        rt.pump()
+        actions = rt.pool.resize(2)
+        assert actions["drained"] == [2]
+        drained_dispatches = None
+        for _ in range(10):
+            rt.submit({"input": np.ones((1, 2), np.float32)})
+            clock.advance(0.1)
+            rt.pump()
+            gone = rt.pool.replica_by_rid(2)
+            if gone is not None:
+                assert gone.state == "draining"
+                drained_dispatches = gone.dispatches
+        rt.drain()
+        assert rt.accounting()["unaccounted"] == 0
+        assert rt.pool.replica_by_rid(2) is None        # retired
+        kinds = [e["kind"] for e in rt.pool.events]
+        assert "replica_draining" in kinds and "replica_retired" in kinds
+        if drained_dispatches is not None:
+            # no dispatches landed on the victim after the drain mark
+            assert drained_dispatches <= 2
+
+    def test_fenced_replica_is_preferred_shrink_victim(self):
+        clock = VirtualClock()
+        pool = _pool(clock, n=3)
+        pool.replicas[1].fence(clock.now() + 100.0)
+        pool.resize(2)
+        assert {r.rid for r in pool.replicas} == {0, 2}
+
+    def test_protected_session_replicas_are_not_drained(self):
+        clock = VirtualClock()
+        pool = _pool(clock, n=3)
+        pool.resize(2, protected=[2])
+        assert pool.replica_by_rid(2) is not None       # protected
+        assert pool.replica_by_rid(1) is None           # next-highest went
+
+
+# ---------------------------------------------------------------------------
+# Fence budget: redispatch on fence, bounded by the knob (OBS_r02 fix)
+# ---------------------------------------------------------------------------
+
+
+class TestFenceBudget:
+    def _run(self, fence_budget_s, delay=5.0):
+        clock = VirtualClock()
+        monkey = ChaosMonkey([FaultSpec(
+            "slow_forward", 1, batches=2,
+            detail={"replica": 0, "delay_s": delay})])
+        rt = ServingRuntime(
+            [ServingTier("fp", _fwd)], n_replicas=2, clock=clock,
+            queue_capacity=16, max_batch=2, default_deadline_s=30.0,
+            wedge_timeout_s=2.0, restart_s=1.0,
+            service_time=lambda e, n, t: 0.05, chaos=monkey,
+            fence_budget_s=fence_budget_s)
+        t0 = clock.now()
+        for _ in range(2):
+            rt.submit({"input": np.ones((1, 2), np.float32)})
+        rt.pump(force=True)
+        rt.drain()
+        return rt, clock, t0
+
+    def test_redispatch_segment_bounded_by_the_knob(self):
+        """With the budget armed the wedge is observed AT THE FENCE
+        INSTANT, not when the 5 s wedged forward finally returns — the
+        whole failed-attempt segment is bounded by the knob (the
+        OBS_r02 tail's 95 % failover_redispatch cohort gap)."""
+        budget = 0.4
+        rt, clock, t0 = self._run(budget)
+        fences = [e for e in rt.pool.events
+                  if e["kind"] == "replica_fenced"]
+        assert len(fences) == 1 and fences[0]["replica"] == 0
+        assert fences[0]["t"] == pytest.approx(t0 + budget)
+        assert "fence budget" in fences[0]["error"]
+        # the batch failed over exactly once and completed within
+        # budget + one healthy service time — NOT the 5 s wedge
+        assert rt.accounting()["by_state"] == {"done": 2}
+        done_t = max(r.completed_t for r in rt.requests)
+        assert done_t == pytest.approx(t0 + budget + 0.05)
+        assert all(r.attempts == 2 for r in rt.requests)
+
+    def test_legacy_default_waits_out_the_wedge(self):
+        """fence_budget_s=None keeps the PR-5 return-then-check path:
+        the batch rides out the full wedge before redispatch (what the
+        banked drills replay)."""
+        rt, clock, t0 = self._run(None)
+        fences = [e for e in rt.pool.events
+                  if e["kind"] == "replica_fenced"]
+        assert len(fences) == 1
+        assert fences[0]["t"] >= t0 + 5.0               # full wedge
+        assert rt.accounting()["by_state"] == {"done": 2}
+
+
+# ---------------------------------------------------------------------------
+# Multiplexing: EWMA isolation, batch isolation, weighted EDF
+# ---------------------------------------------------------------------------
+
+
+def _mux_batcher(clock, service_time=None):
+    queue = AdmissionQueue(64, clock)
+    plans = {"a": ModelPlan(), "b": ModelPlan()}
+    return queue, DeadlineBatcher(queue, max_batch=4,
+                                  service_time=service_time, plans=plans)
+
+
+def _req(rid, model, deadline_t, clock, length=None):
+    return Request(rid=rid, payload={"input": np.ones((1, 2), np.float32)},
+                   arrival_t=clock.now(), deadline_t=deadline_t,
+                   model=model, length=length)
+
+
+class TestMultiplexedBatching:
+    def test_second_model_does_not_inherit_service_estimate(self):
+        """ISSUE 14 satellite: the EWMA keys per (model, edge, tier)
+        with the PR-5 always-urgent seed PER KEY — model b's first
+        batch flushes immediately instead of waiting on model a's
+        learned estimate."""
+        clock = VirtualClock()
+        queue, b = _mux_batcher(clock)
+        b.observe_service_s("fixed", 0.05, tier=0, model="a")
+        assert b.estimate_s("fixed", 1, 0, model="a") == 0.05
+        assert b.estimate_s("fixed", 1, 0, model="b") == float("inf")
+        # a singleton for model b (deadline far away) is still urgent
+        queue.submit(_req(0, "b", clock.now() + 100.0, clock))
+        batch = b.next_batch({"a": 0, "b": 0})
+        assert batch is not None and batch.model == "b"
+        assert batch.n_valid == 1
+        # and b's own observation replaces the cold seed, per tier
+        b.observe_service_s("fixed", 0.2, tier=0, model="b")
+        assert b.estimate_s("fixed", 1, 0, model="b") == 0.2
+        assert b.estimate_s("fixed", 1, 1, model="b") == float("inf")
+
+    def test_models_never_share_a_batch(self):
+        clock = VirtualClock()
+        queue, b = _mux_batcher(clock)
+        for i in range(6):
+            queue.submit(_req(i, "a" if i % 2 else "b",
+                              clock.now() + 0.1 * (i + 1), clock))
+        seen = []
+        while True:
+            batch = b.next_batch({"a": 0, "b": 0}, force=True)
+            if batch is None:
+                break
+            seen.append(batch)
+            assert {r.model for r in batch.requests} == {batch.model}
+        assert sorted(x.model for x in seen) == ["a", "b"]
+        assert sum(x.n_valid for x in seen) == 6
+
+    def test_weighted_edf_negative_slack_stays_boosted(self):
+        """Overdue buckets (possible under shed_expired=False) must
+        rank MORE urgent for a burning model, not less — negative
+        slack multiplies by the weight instead of dividing."""
+        clock = VirtualClock()
+        queue = AdmissionQueue(64, clock,
+                               shed_expired=False)
+        b = DeadlineBatcher(queue, max_batch=4,
+                            service_time=lambda m, e, n, t: 10.0,
+                            plans={"a": ModelPlan(), "b": ModelPlan()})
+        clock.advance(5.0)
+        # both buckets overdue: a by 0.5 s, burning b by 1.0 s
+        queue.submit(_req(0, "a", clock.now() - 0.5, clock))
+        queue.submit(_req(1, "b", clock.now() - 1.0, clock))
+        b.set_model_weight("b", 4.0)
+        first = b.next_batch({"a": 0, "b": 0})
+        assert first.model == "b"       # -1.0*4 < -0.5/1
+
+    def test_weighted_edf_boosts_the_burning_model(self):
+        """Plain EDF would dispatch model a (earlier deadline) first;
+        weighting b by its burn rate divides b's slack, so b wins the
+        next dispatch — deadline weighted by budget-burn."""
+        clock = VirtualClock()
+        queue, b = _mux_batcher(
+            clock, service_time=lambda m, e, n, t: 10.0)  # all urgent
+        queue.submit(_req(0, "a", clock.now() + 1.0, clock))
+        queue.submit(_req(1, "b", clock.now() + 2.0, clock))
+        tiers = {"a": 0, "b": 0}
+        # unweighted: earliest deadline (a) first
+        first = b.next_batch(tiers)
+        assert first.model == "a"
+        queue.submit(_req(2, "a", clock.now() + 1.0, clock))
+        b.set_model_weight("b", 4.0)        # b is burning 4x
+        boosted = b.next_batch(tiers)
+        assert boosted.model == "b"         # 2.0/4 < 1.0/1
+        assert b.model_weight("b") == 4.0
+
+    def test_per_model_max_batch_and_plan_validation(self):
+        clock = VirtualClock()
+        queue = AdmissionQueue(64, clock)
+        b = DeadlineBatcher(queue, max_batch=4,
+                            plans={"a": ModelPlan(max_batch=2)})
+        for i in range(3):
+            queue.submit(_req(i, "a", clock.now() + 100.0, clock))
+        batch = b.next_batch({"a": 0})
+        assert batch.n_valid == 2           # per-model cap, not global
+        assert batch.batch["input"].shape[0] == 2
+        with pytest.raises(KeyError):
+            b.bucket_of(_req(9, "zz", 1.0, clock))
+
+
+# ---------------------------------------------------------------------------
+# The multiplexed runtime end-to-end (two models + autoscaler)
+# ---------------------------------------------------------------------------
+
+
+def _mux_runtime(clock, autoscaler=None, **kw):
+    models = [
+        ModelConfig(name="vision",
+                    tiers=[ServingTier("fp", _fwd),
+                           ServingTier("int8", _fwd, 0.7)],
+                    length_key=None, default_deadline_s=0.3,
+                    slos=model_slos("vision")),
+        ModelConfig(name="fraud",
+                    tiers=[ServingTier("fp", _fwd)],
+                    length_key=None, default_deadline_s=0.1,
+                    slos=model_slos("fraud")),
+    ]
+    kw.setdefault("queue_capacity", 64)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("decision_every", 4)
+    kw.setdefault("service_time",
+                  lambda m, e, n, t: 0.05 if m == "vision" else 0.01)
+    kw.setdefault("slo_params", dict(fast_window_s=2.0, slow_window_s=20.0,
+                                     time_scale=1.0))
+    return ServingRuntime(models=models, n_replicas=1, clock=clock,
+                          autoscaler=autoscaler, **kw)
+
+
+class TestMultiplexedRuntime:
+    def _overload(self, rt, clock, n=1200, rate=700.0):
+        from analytics_zoo_tpu.resilience.errors import ServerOverloaded
+
+        t, script = 0.0, []
+        for i in range(n):
+            t += 1.0 / rate
+            script.append((t, "vision" if i % 3 else "fraud"))
+        i = 0
+        while i < n:
+            if clock.now() < script[i][0]:
+                if rt.pump() == 0:
+                    clock.advance(script[i][0] - clock.now())
+                continue
+            while i < n and clock.now() >= script[i][0]:
+                t_sched, m = script[i]
+                dl = 0.3 if m == "vision" else 0.1
+                try:
+                    rt.submit({"input": np.ones((1, 2), np.float32)},
+                              model=m,
+                              deadline_s=max(t_sched + dl - clock.now(),
+                                             1e-9))
+                except ServerOverloaded:
+                    pass
+                i += 1
+            rt.pump()
+        rt.drain()
+
+    def test_autoscaler_actuates_and_conserves(self):
+        """The closed loop end-to-end: sustained overload burns the
+        per-model SLOs, the policy loop grows the pool through the
+        runtime's actuator (pre-warmed), and every request still ends
+        terminal."""
+        clock = VirtualClock()
+        scaler = Autoscaler(AutoscalePolicy(
+            min_replicas=1, max_replicas=4, grow_after=1, shrink_after=4,
+            cooldown=1))
+        rt = _mux_runtime(clock, autoscaler=scaler, compile_s=0.5)
+        self._overload(rt, clock)
+        assert rt.accounting()["unaccounted"] == 0
+        assert scaler.grows >= 1
+        assert rt.pool.size > 1
+        assert rt.pool.cold_compiles == 0       # growth was pre-warmed
+        snap = rt.snapshot()
+        assert set(snap["models"]) == {"vision", "fraud"}
+        assert snap["autoscale"]["grows"] == scaler.grows
+        joined = [e for e in rt.pool.events
+                  if e["kind"] == "replica_joined"]
+        assert joined and all(e["prewarm"] for e in joined)
+        assert any(e["kind"] == "replica_prewarmed"
+                   for e in rt.pool.events)
+
+    def test_burn_drives_weights_and_per_model_ladders(self):
+        clock = VirtualClock()
+        rt = _mux_runtime(clock)
+        self._overload(rt, clock)
+        assert rt.accounting()["unaccounted"] == 0
+        # both models burned -> weights rose off the 1.0 floor
+        assert rt.batcher.model_weight("vision") > 1.0
+        assert rt.batcher.model_weight("fraud") > 1.0
+        # the two-tier model stepped down on ITS slo burn; the ladder
+        # event records which SLOs drove it
+        vision = rt.ladders["vision"]
+        downs = [e for e in vision.events if e["kind"] == "tier_down"]
+        assert downs and any("model=vision" in s
+                             for s in downs[0]["slo_burning"])
+        reg = rt.metrics.registry
+        assert reg.gauge("serve/model_weight/model=vision").value > 1.0
+        assert rt.metrics.model_snapshot("fraud")["submitted"] > 0
+
+    def test_submit_requires_model_when_multiplexed(self):
+        clock = VirtualClock()
+        rt = _mux_runtime(clock)
+        with pytest.raises(ValueError, match="submit\\(model=...\\)"):
+            rt.submit({"input": np.ones((1, 2), np.float32)})
+        with pytest.raises(KeyError, match="unknown model"):
+            rt.submit({"input": np.ones((1, 2), np.float32)},
+                      model="nope")
+
+
+# ---------------------------------------------------------------------------
+# Streaming sessions: affinity, in-order chunks, per-chunk deadlines
+# ---------------------------------------------------------------------------
+
+
+def _stateful_tiers():
+    """A cheap stateful session model: each session's forward output is
+    its running chunk count — any out-of-order, dropped, or
+    wrong-replica dispatch changes the sequence."""
+    stores = []
+
+    def factory(rid):
+        store = {}
+        stores.append((rid, store))
+
+        def forward(batch):
+            out = []
+            for i, sid in enumerate(batch["session"]):
+                sid = int(sid)
+                if sid < 0:
+                    out.append(-1)
+                    continue
+                store[sid] = store.get(sid, 0) + 1
+                out.append(store[sid])
+            return np.asarray(out)
+        return [ServingTier("stream", forward,
+                            evict_session=lambda s: store.pop(s, None))]
+
+    return factory, stores
+
+
+def _session_runtime(clock, n_replicas=2, **kw):
+    factory, stores = _stateful_tiers()
+    cfg = ModelConfig(name="stream", streaming=True,
+                      tiers=factory(-1), tier_factory=factory,
+                      length_key=None, chunk_deadline_s=0.5)
+    kw.setdefault("service_time", lambda m, e, n, t: 0.01)
+    rt = ServingRuntime(models=[cfg], n_replicas=n_replicas, clock=clock,
+                        queue_capacity=32, max_batch=4, **kw)
+    return rt, stores
+
+
+class TestStreamingSessions:
+    def test_session_affinity_and_in_order_chunks(self):
+        """Chunks dispatch to exactly the pinned replica's store, in
+        submission order (incremental deadlines are monotone under
+        EDF), across interleaved sessions on different replicas."""
+        clock = VirtualClock()
+        rt, stores = _session_runtime(clock)
+        s1 = rt.open_session("stream")
+        s2 = rt.open_session("stream")
+        pin1 = rt._sessions[s1]["replica"]
+        pin2 = rt._sessions[s2]["replica"]
+        assert pin1 != pin2                     # least-loaded spread
+        reqs = {s1: [], s2: []}
+        for k in range(4):
+            for sid in (s1, s2):
+                reqs[sid].append(rt.submit_chunk(
+                    sid, {"input": np.ones((1, 2), np.float32)},
+                    final=(k == 3)))
+            clock.advance(0.05)
+            rt.pump()
+        rt.drain()
+        assert rt.accounting()["by_state"] == {"done": 8}
+        for sid in (s1, s2):
+            # in-order: the stateful counter saw chunks 1..4 in order
+            assert [int(r.result) for r in reqs[sid]] == [1, 2, 3, 4]
+        # the state lives ONLY on the pinned replica's store
+        by_rid = dict(stores)
+        assert by_rid[pin1].get(s1) == 4 and s2 not in by_rid[pin1]
+        assert by_rid[pin2].get(s2) == 4 and s1 not in by_rid[pin2]
+        # closed on the final chunk
+        assert rt.snapshot()["sessions"]["open"] == 0
+        with pytest.raises(RuntimeError, match="closed"):
+            rt.submit_chunk(s1, {"input": np.ones((1, 2), np.float32)})
+
+    def test_per_chunk_deadlines_are_incremental(self):
+        """Each chunk's deadline anchors at ITS submit instant — a
+        long-lived stream never inherits the session-open instant."""
+        clock = VirtualClock()
+        rt, _ = _session_runtime(clock)
+        sid = rt.open_session("stream")
+        r1 = rt.submit_chunk(sid, {"input": np.ones((1, 2), np.float32)})
+        rt.pump(force=True)                 # serve chunk 1 in time
+        clock.advance(10.0)                 # a long quiet gap
+        r2 = rt.submit_chunk(sid, {"input": np.ones((1, 2), np.float32)})
+        assert r1.deadline_t == pytest.approx(r1.arrival_t + 0.5)
+        assert r2.deadline_t == pytest.approx(r2.arrival_t + 0.5)
+        assert r2.arrival_t >= r1.arrival_t + 10.0
+
+    def test_shed_chunk_kills_the_session_and_evicts_its_state(self):
+        """A mid-stream chunk shed on deadline leaves a GAP in the
+        carry — the session must fail honestly (no silently corrupted
+        transcript returned as 'done') and its replica-side state must
+        be evicted, not leaked."""
+        clock = VirtualClock()
+        rt, stores = _session_runtime(clock)
+        sid = rt.open_session("stream")
+        pin = rt._sessions[sid]["replica"]
+        r1 = rt.submit_chunk(sid, {"input": np.ones((1, 2), np.float32)})
+        rt.pump(force=True)                         # chunk 1 served
+        r2 = rt.submit_chunk(sid, {"input": np.ones((1, 2), np.float32)})
+        clock.advance(1.0)                          # past the 0.5 s budget
+        rt.pump()                                   # expires -> shed
+        assert r1.state == "done" and r2.state == "timeout"
+        snap = rt.snapshot()["sessions"]
+        assert snap["failed"] == 1 and snap["open"] == 0
+        with pytest.raises(RuntimeError, match="closed"):
+            rt.submit_chunk(sid, {"input": np.ones((1, 2), np.float32)})
+        # the pinned replica's store entry was evicted, and the pin no
+        # longer protects the replica from shrink
+        assert dict(stores)[pin] == {}
+        assert rt._session_rids() == set()
+        assert rt.accounting()["unaccounted"] == 0
+
+    def test_custom_chunk_deadlines_clamped_monotone(self):
+        """EDF order IS chunk order — a caller-supplied deadline_s
+        earlier than a previous chunk's is clamped up to the session's
+        high-water mark instead of silently reordering the decode."""
+        clock = VirtualClock()
+        rt, _ = _session_runtime(clock)
+        sid = rt.open_session("stream")
+        r1 = rt.submit_chunk(sid, {"input": np.ones((1, 2), np.float32)},
+                             deadline_s=5.0)
+        r2 = rt.submit_chunk(sid, {"input": np.ones((1, 2), np.float32)},
+                             deadline_s=0.1)
+        assert r2.deadline_t >= r1.deadline_t
+        rt.drain()
+        assert [int(r.result) for r in (r1, r2)] == [1, 2]  # in order
+
+    def test_close_session_releases_pin_and_evicts_state(self):
+        """An abandoned stream closed WITHOUT a flush chunk frees its
+        replica pin (autoscaler shrink unblocked) and evicts the
+        replica-side carry."""
+        clock = VirtualClock()
+        rt, stores = _session_runtime(clock)
+        sid = rt.open_session("stream")
+        pin = rt._sessions[sid]["replica"]
+        rt.submit_chunk(sid, {"input": np.ones((1, 2), np.float32)})
+        rt.pump(force=True)
+        assert rt._session_rids() == {pin}
+        rt.close_session(sid)
+        assert rt._session_rids() == set()
+        assert dict(stores)[pin] == {}
+        assert rt.snapshot()["sessions"]["open"] == 0
+        rt.close_session(sid)               # idempotent no-op
+        with pytest.raises(RuntimeError, match="closed"):
+            rt.submit_chunk(sid, {"input": np.ones((1, 2), np.float32)})
+
+    def test_streaming_model_rejects_plain_submit(self):
+        clock = VirtualClock()
+        rt, _ = _session_runtime(clock)
+        with pytest.raises(ValueError, match="open_session"):
+            rt.submit({"input": np.ones((1, 2), np.float32)},
+                      model="stream")
+
+    def test_streaming_config_requires_tier_factory(self):
+        with pytest.raises(ValueError, match="tier_factory"):
+            ModelConfig(name="s", streaming=True,
+                        tiers=[ServingTier("x", _fwd)])
+
+    def test_streaming_config_rejects_multiple_bucket_edges(self):
+        """Chunk order relies on one (model, affinity, edge) group per
+        session — a second edge would let a later chunk's bucket flush
+        first and decode out of order."""
+        factory, _ = _stateful_tiers()
+        with pytest.raises(ValueError, match="one.*bucket edge|bucket "
+                                             "edge"):
+            ModelConfig(name="s", streaming=True, tiers=factory(-1),
+                        tier_factory=factory,
+                        bucket_edges=[8000, 16000])
+        # a single edge is fine
+        ModelConfig(name="s", streaming=True, tiers=factory(-1),
+                    tier_factory=factory, bucket_edges=[8000])
+
+    def test_dead_sessions_queued_chunks_fail_without_recreating_state(
+            self):
+        """Chunks admitted before their session was killed must FAIL at
+        dispatch (not serve garbage marked done) and must not recreate
+        the evicted store entry on the replica."""
+        clock = VirtualClock()
+        rt, stores = _session_runtime(clock, n_replicas=1)
+        sid = rt.open_session("stream")
+        # three chunks queued (none urgent yet), then a fourth is shed
+        # at the door by a full queue -> the session is killed with
+        # chunks still queued
+        queued = [rt.submit_chunk(
+            sid, {"input": np.ones((1, 2), np.float32)})
+            for _ in range(3)]
+        rt.queue.capacity = 3
+        from analytics_zoo_tpu.resilience.errors import ServerOverloaded
+        with pytest.raises(ServerOverloaded):
+            rt.submit_chunk(sid, {"input": np.ones((1, 2), np.float32)})
+        assert rt.snapshot()["sessions"]["failed"] == 1
+        rt.drain()
+        assert all(r.state == "failed" for r in queued), \
+            [r.state for r in queued]
+        # the store was never recreated by the dead chunks
+        assert all(not s for s in dict(stores).values())
+        assert rt.accounting()["unaccounted"] == 0

@@ -105,6 +105,62 @@ class TestUnidirectionalModel:
                            return_carry=True)
 
 
+class TestStreamingSessionServing:
+    def test_served_sessions_match_direct_streaming_exactly(self):
+        """ISSUE 14: StreamingDS2 as a first-class session type on the
+        multiplexed runtime — three concurrent sessions, session-affine
+        scheduling over two replicas, per-chunk incremental deadlines —
+        and every session's transcript (incl. the final-chunk flush
+        tail) EXACTLY equals driving StreamingDS2 directly."""
+        from analytics_zoo_tpu.pipelines.deepspeech2 import (
+            ds2_streaming_tiers)
+        from analytics_zoo_tpu.serving import (ModelConfig,
+                                               ServingRuntime,
+                                               VirtualClock)
+
+        model = _uni_model(hidden=16, layers=1)
+        CHUNK = 5000
+        cfg = ModelConfig(
+            name="ds2-stream", streaming=True,
+            tiers=ds2_streaming_tiers(model, chunk_frames=50),
+            tier_factory=lambda rid: ds2_streaming_tiers(
+                model, chunk_frames=50),
+            pad_key="input", length_key="n_samples",
+            bucket_edges=[CHUNK], chunk_deadline_s=2.0)
+        clock = VirtualClock()
+        rt = ServingRuntime(models=[cfg], n_replicas=2, clock=clock,
+                            queue_capacity=32, max_batch=4,
+                            service_time=lambda m, e, n, t: 0.02)
+        rng = np.random.RandomState(0)
+        utts = {s: (rng.randn(20000) * 0.1).astype(np.float32)
+                for s in range(3)}
+        sids = {s: rt.open_session("ds2-stream") for s in utts}
+        pins = {s: rt._sessions[sids[s]]["replica"] for s in utts}
+        assert set(pins.values()) == {0, 1}     # least-loaded spread
+        reqs = {s: [] for s in utts}
+        for k in range(0, 20000, CHUNK):
+            for s, samples in utts.items():
+                chunk = samples[k:k + CHUNK]
+                reqs[s].append(rt.submit_chunk(
+                    sids[s], {"input": chunk}, length=len(chunk),
+                    final=(k + CHUNK >= 20000)))
+            clock.advance(0.1)
+            rt.pump()
+        rt.drain()
+        acct = rt.accounting()
+        assert acct["unaccounted"] == 0
+        assert acct["by_state"] == {"done": 12}
+        for s, samples in utts.items():
+            direct = StreamingDS2(model, chunk_frames=50)
+            pieces = [direct.accept(samples[k:k + CHUNK])
+                      for k in range(0, 20000, CHUNK)]
+            pieces.append(direct.flush())
+            served = "".join(str(r.result) for r in reqs[s])
+            assert served == "".join(pieces), s
+        assert rt.snapshot()["sessions"] == {
+            "opened": 3, "open": 0, "failed": 0}
+
+
 class TestStreamGuards:
     def test_accept_after_flush_raises(self):
         model = _uni_model(hidden=16, layers=1)

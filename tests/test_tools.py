@@ -304,6 +304,99 @@ class TestServeDrillHelpers:
                 < out["miss_rate"]["baseline_no_shedding"])
 
 
+class TestServeFleetDrill:
+    """tools/serve_fleet_drill.py (ISSUE 14): the multiplexed fleet +
+    closed-loop autoscaler smoke, and the committed million-request
+    SERVING_SCALE_r01.json artifact's claims."""
+
+    def test_smoke_drill_mechanics_and_conservation(self):
+        from tools.serve_fleet_drill import fleet_drill
+
+        out = fleet_drill(seed=0, smoke=True)
+        assert out["checks"]["ok"], out["checks"]
+        # the hard invariants, re-asserted explicitly
+        assert out["static_pool"]["accounting"]["unaccounted"] == 0
+        assert out["autoscaled"]["accounting"]["unaccounted"] == 0
+        assert (out["static_pool"]["accounting"]["submitted"]
+                == out["autoscaled"]["accounting"]["submitted"]
+                == out["config"]["n_requests"])
+        # every scenario replayed byte-identically from the seed
+        for arm in (out["static_pool"], out["autoscaled"],
+                    out["prewarm_subphase"]["on"],
+                    out["prewarm_subphase"]["off"]):
+            assert arm["replay"]["replay_identical"] is True
+        # the closed loop actuated, growth was pre-warmed, and the
+        # cold arm of the sub-phase really paid the compile tax
+        assert out["autoscaled"]["autoscale"]["grows"] >= 1
+        assert out["prewarm_subphase"]["on"]["pool"]["cold_compiles"] == 0
+        assert out["prewarm_subphase"]["off"]["pool"]["cold_compiles"] > 0
+
+    def test_committed_fleet_artifact_banks_the_scale_claims(self):
+        """The committed full-scale artifact's own claims (strict —
+        the smoke relaxations never apply to it): ~1M requests per arm
+        at equal trace, requests conserved in both arms, autoscaled
+        goodput > static with strictly lower miss rate, the pre-warm
+        on/off sub-phase present with the cold-compile tax banked, and
+        byte-identical replay throughout."""
+        import json
+
+        from tools.check_artifacts import LEGACY, PATTERN, REQUIRED_KEYS
+
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "SERVING_SCALE_r01.json")
+        report = json.load(open(path))
+        assert report["verdict"] == "PASS" and report["checks"]["ok"]
+        assert report["smoke"] is False
+        cfg = report["config"]
+        assert cfg["n_requests"] >= 900_000
+        static, auto = report["static_pool"], report["autoscaled"]
+        # equal trace, both arms, nothing lost
+        assert (static["accounting"]["submitted"]
+                == auto["accounting"]["submitted"]
+                == cfg["n_requests"])
+        assert static["accounting"]["unaccounted"] == 0
+        assert auto["accounting"]["unaccounted"] == 0
+        assert cfg["trace_sha256"]
+        # the headline: goodput up, miss rate strictly down, at equal
+        # offered load
+        assert auto["goodput_rps"] > static["goodput_rps"]
+        assert (auto["deadline_miss_rate"]
+                < static["deadline_miss_rate"])
+        assert report["headline"]["goodput_gain"] > 1.0
+        # the loop actuated both directions and growth pre-warmed
+        assert auto["autoscale"]["grows"] >= 1
+        assert auto["autoscale"]["shrinks"] >= 1
+        assert auto["pool"]["max"] > auto["pool"]["initial"]
+        assert auto["pool"]["cold_compiles"] == 0
+        # pre-warm sub-phase: the tax exists and pre-warm deletes it
+        sub = report["prewarm_subphase"]
+        assert sub["off"]["pool"]["cold_compiles"] > 0
+        assert sub["on"]["pool"]["cold_compiles"] == 0
+        assert sub["cold_compile_tax_s"] > 0
+        assert (sub["on"]["deadline_miss_rate"]
+                <= sub["off"]["deadline_miss_rate"])
+        # replay discipline (the OBS_r02 standard)
+        for arm in (static, auto, sub["on"], sub["off"]):
+            assert arm["replay"]["replay_identical"] is True
+        # governed by the artifact lint as STAMPED, not grandfathered
+        assert PATTERN.match("SERVING_SCALE_r01.json")
+        assert "SERVING_SCALE_r01.json" not in LEGACY
+        meta = report["run_metadata"]
+        assert all(k in meta for k in REQUIRED_KEYS)
+
+    def test_cli_smoke_writes_stamped_artifact(self, tmp_path):
+        import json
+
+        import tools.serve_fleet_drill as fd
+
+        out = tmp_path / "SERVING_SCALE_smoke.json"
+        rc = fd.main(["--smoke", "--out", str(out), "--seed", "0"])
+        assert rc == 0
+        report = json.loads(out.read_text())
+        assert report["verdict"] == "PASS"
+        assert "run_metadata" in report
+
+
 class TestObsDrillHelpers:
     """Fast pieces of tools/obs_drill.py (the committed OBS_r01.json is
     the full-size execution: drill-scale flight recording + replay hash

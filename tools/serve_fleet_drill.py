@@ -1,0 +1,518 @@
+"""The million-request fleet drill: multi-model multiplexing + the
+closed-loop autoscaler, A/B'd against a static pool on ONE seeded trace.
+
+ROADMAP item 1's banked artifact (``SERVING_SCALE_r01.json``): four
+model families (ssd / frcnn / ds2 / fraud — tiny REAL jitted models so
+the programs are genuine, while *time* is virtual) multiplexed on one
+``ServingRuntime`` over a shared ``ReplicaPool``, driven through a
+seeded **diurnal + burst** arrival trace of ~1M requests on the
+``VirtualClock``.  Two arms at EQUAL offered load:
+
+- **static**: a fixed pool sized for the diurnal MEAN — the burst and
+  the diurnal peak overrun it, and the ladder + shedding absorb what
+  they can (the PR-5 story at fleet scale);
+- **autoscaled**: the same runtime with the ISSUE-14 closed loop armed
+  — per-model SLO burn rates drive ``scale_hint``, the
+  ``Autoscaler`` policy loop actuates ``ReplicaPool.resize``, growth
+  **pre-warms** every (model, edge, tier) program before the replica
+  joins dispatch, and the trough drains-then-retires back down.
+
+The headline is **goodput** — deadline-met requests per second — and
+the deadline-miss rate: the autoscaled arm must beat the static pool on
+BOTH at equal trace.  A second, shorter burst-only sub-phase A/Bs
+**pre-warm on vs off** at equal policy: the cold arm joins replicas
+immediately but pays ``compile_s`` per first-dispatch geometry ON the
+hot path (counted ``cold_compile`` events), quantifying exactly the
+compile tax pre-warm deletes.
+
+Determinism: the trace is inverse-CDF sampled from the seeded uniform
+grid against the diurnal+burst intensity profile, time is virtual,
+every scenario runs TWICE and the artifact records that the replay was
+byte-identical (the OBS_r02 discipline).  ``ServingRuntime(
+retain_requests=False)`` keeps memory O(pool+queue) at any request
+count; accounting stays exact via the runtime's incremental terminal
+counters.
+
+Usage::
+
+    python tools/serve_fleet_drill.py            # full ~1M-request drill
+    python tools/serve_fleet_drill.py --smoke    # CI-sized (~10k, seconds)
+"""
+
+import argparse
+import hashlib
+import json
+import math
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REVISION = "r01"
+
+#: offered-load geometry (full drill; --smoke divides N_REQUESTS)
+N_REQUESTS = 1_000_000
+MEAN_RATE = 450.0               # req/s averaged over the day
+DIURNAL_AMP = 0.45              # peak 1.45x mean, trough 0.55x
+BURST_X = 2.5                   # extra multiplier inside the burst window
+BURST_WINDOW = (0.55, 0.65)     # fraction of the day
+MODEL_MIX = (("ssd", 0.30), ("frcnn", 0.15), ("ds2", 0.25),
+             ("fraud", 0.30))
+DEADLINES = {"ssd": 0.25, "frcnn": 0.40, "ds2": 0.35, "fraud": 0.08}
+DS2_EDGES = (32, 64, 96)
+
+#: virtual service seconds per max_batch=8 batch at tier 0
+SERVICE = {"ssd": 0.050, "frcnn": 0.080, "ds2": 0.040, "fraud": 0.008}
+TIER_SPEEDS = {"ssd": (1.0, 0.75), "frcnn": (1.0, 0.77),
+               "ds2": (1.0, 0.8), "fraud": (1.0, 0.8)}
+
+MAX_BATCH = 8
+QUEUE_CAPACITY = 384
+DECISION_EVERY = 48
+COMPILE_S = 1.5                 # per-(model, edge, tier) compile cost
+STATIC_REPLICAS = 3
+AUTOSCALE = dict(min_replicas=2, max_replicas=8, grow_after=1,
+                 shrink_after=8, cooldown=1, step=1)
+
+
+def service_time(model, edge, n, tier):
+    base = SERVICE[model]
+    if model == "ds2":
+        base *= int(edge) / float(DS2_EDGES[-1])
+    return base * TIER_SPEEDS[model][tier]
+
+
+def geometry_count(configs):
+    """(model, edge, tier) programs a replica pre-warms — derived from
+    the ModelConfigs exactly like ``ServingRuntime._geometry_plan``, so
+    the banked config can't drift from what replicas actually warm."""
+    return sum(len(cfg.bucket_edges or [None]) * len(cfg.tiers)
+               for cfg in configs)
+
+
+# ---------------------------------------------------------------------------
+# Trace synthesis (numpy, seeded, vectorized)
+# ---------------------------------------------------------------------------
+
+
+def intensity_profile(day_s: float, burst: bool, k: int = 2048):
+    """Piecewise intensity over the day: diurnal sinusoid (+ the burst
+    window's extra multiplier).  Returns (grid_t, cumulative mass)."""
+    t = np.linspace(0.0, day_s, k + 1)
+    frac = t / day_s
+    rate = 1.0 + DIURNAL_AMP * np.sin(2 * math.pi * (frac - 0.25))
+    if burst:
+        in_burst = (frac >= BURST_WINDOW[0]) & (frac < BURST_WINDOW[1])
+        rate = rate * np.where(in_burst, BURST_X, 1.0)
+    cum = np.concatenate([[0.0], np.cumsum((rate[1:] + rate[:-1]) * 0.5
+                                           * np.diff(t))])
+    return t, cum / cum[-1]
+
+
+def build_trace(seed: int, n: int, day_s: float, burst: bool = True):
+    """The seeded arrival script as flat arrays: sorted arrival times
+    inverse-CDF sampled against the diurnal(+burst) intensity, the
+    per-request model, and the ds2 rows' variable lengths."""
+    rng = np.random.default_rng(seed)
+    grid_t, cdf = intensity_profile(day_s, burst)
+    u = np.sort(rng.random(n))
+    t_arr = np.interp(u, cdf, grid_t)
+    names = [m for m, _ in MODEL_MIX]
+    probs = np.asarray([p for _, p in MODEL_MIX])
+    model_idx = rng.choice(len(names), size=n, p=probs).astype(np.int8)
+    lengths = rng.integers(18, DS2_EDGES[-1] + 1,
+                           size=n).astype(np.int16)
+    return {"t": t_arr, "model_idx": model_idx, "lengths": lengths,
+            "names": names, "day_s": day_s, "n": n, "burst": burst}
+
+
+def trace_digest(trace) -> str:
+    h = hashlib.sha256()
+    for key in ("t", "model_idx", "lengths"):
+        h.update(np.ascontiguousarray(trace[key]).tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# The multiplexed model set (tiny REAL jitted programs)
+# ---------------------------------------------------------------------------
+
+
+def build_model_set(seed: int):
+    """Four tiny-but-real model families, each with an fp + weight-only
+    int8 tier (the quantize_params mechanism, like every production
+    ladder in the repo) and ``device_program`` audit hooks.  Shared
+    across arms — the tier forwards are stateless, so both arms (and
+    the replay runs) dispatch the SAME compiled programs."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.parallel import make_eval_step
+    from analytics_zoo_tpu.serving import ModelConfig, ServingTier
+    from analytics_zoo_tpu.obs.slo import model_slos
+    from analytics_zoo_tpu.utils.quantize import (make_quantized_forward,
+                                                  quantize_params)
+
+    dims = {"ssd": 64, "frcnn": 96, "ds2": 8, "fraud": 29}
+    configs = []
+    for i, (name, _) in enumerate(MODEL_MIX):
+        module = nn.Dense(4)
+        model = Model(module)
+        in_dim = dims[name]
+        example = (jnp.zeros((1, DS2_EDGES[0], in_dim), jnp.float32)
+                   if name == "ds2"
+                   else jnp.zeros((1, in_dim), jnp.float32))
+        model.build(seed + i, example)
+        eval_step = make_eval_step(module)
+        qparams = quantize_params(model.variables)
+        qfwd = make_quantized_forward(module)
+
+        def fwd_fp(batch, _ev=eval_step, _m=model):
+            return np.asarray(_ev(_m.variables,
+                                  jnp.asarray(batch["input"])))
+
+        def fwd_int8(batch, _q=qfwd, _p=qparams):
+            return np.asarray(_q(_p, jnp.asarray(batch["input"])))
+
+        def audit_fp(_ev=eval_step, _m=model, _d=in_dim, _name=name):
+            shape = ((1, DS2_EDGES[0], _d) if _name == "ds2"
+                     else (1, _d))
+            return (_ev, (_m.variables,
+                          jax.ShapeDtypeStruct(shape, jnp.float32)), ())
+
+        tiers = [
+            ServingTier("fp", fwd_fp, speed=TIER_SPEEDS[name][0],
+                        quality_note="fp32 weights",
+                        device_program=audit_fp),
+            ServingTier("int8", fwd_int8, speed=TIER_SPEEDS[name][1],
+                        quality_note="weight-only int8 (quantize_params)"),
+        ]
+        configs.append(ModelConfig(
+            name=name, tiers=tiers,
+            bucket_edges=list(DS2_EDGES) if name == "ds2" else None,
+            length_key="n_frames" if name == "ds2" else None,
+            default_deadline_s=DEADLINES[name],
+            slos=model_slos(name, miss_budget=0.15, shed_budget=0.10)))
+    return configs
+
+
+def build_payloads():
+    """One shared payload array per model (and per ds2 length) — a
+    million Request objects must not mean a million array allocations."""
+    dims = {"ssd": 64, "frcnn": 96, "fraud": 29}
+    payloads = {name: {"input": np.ones((d,), np.float32)}
+                for name, d in dims.items()}
+    ds2 = {int(n): {"input": np.ones((int(n), 8), np.float32)}
+           for n in range(18, DS2_EDGES[-1] + 1)}
+    return payloads, ds2
+
+
+# ---------------------------------------------------------------------------
+# One scenario run
+# ---------------------------------------------------------------------------
+
+
+def run_scenario(trace, configs, *, autoscale: bool, prewarm: bool = True,
+                 n_replicas: int = STATIC_REPLICAS):
+    """Replay one trace against a fresh runtime; returns the summary
+    dict (deterministic — the replay check hashes it)."""
+    from analytics_zoo_tpu.resilience.errors import ServerOverloaded
+    from analytics_zoo_tpu.serving import (Autoscaler, AutoscalePolicy,
+                                           ServingRuntime, VirtualClock)
+
+    clock = VirtualClock()
+    scaler = None
+    if autoscale:
+        scaler = Autoscaler(AutoscalePolicy(prewarm=prewarm, **AUTOSCALE))
+    rt = ServingRuntime(
+        models=configs, n_replicas=n_replicas, clock=clock,
+        queue_capacity=QUEUE_CAPACITY, max_batch=MAX_BATCH,
+        service_time=service_time, decision_every=DECISION_EVERY,
+        autoscaler=scaler, compile_s=COMPILE_S,
+        slo_params=dict(time_scale=0.01),   # fast 3 s / slow 36 s virtual
+        retain_requests=False, parallel_replicas=True)
+
+    payloads, ds2_payloads = build_payloads()
+    names = trace["names"]
+    t_arr = trace["t"]
+    model_idx = trace["model_idx"]
+    lengths = trace["lengths"]
+    n = trace["n"]
+    pool_sizes = [rt.pool.size]
+    i = 0
+    while i < n:
+        now = clock.now()
+        if now < t_arr[i]:
+            if rt.pump() == 0:
+                # event-driven advance: the next arrival, or the next
+                # pool event (a replica frees / restarts / finishes
+                # pre-warming) — whichever is sooner
+                ev = rt.next_event_t()
+                target = float(t_arr[i]) if ev is None \
+                    else min(ev, float(t_arr[i]))
+                clock.advance(max(target - now, 1e-9))
+            continue
+        # submit every arrival whose instant passed during the last
+        # dispatch — open-loop offered load, deadlines anchored at the
+        # SCHEDULED arrival instant (the serve_drill honesty contract)
+        while i < n and clock.now() >= t_arr[i]:
+            name = names[model_idx[i]]
+            t_sched = float(t_arr[i])
+            if name == "ds2":
+                ln = int(lengths[i])
+                payload, length = ds2_payloads[ln], ln
+            else:
+                payload, length = payloads[name], None
+            try:
+                rt.submit(payload, model=name, length=length,
+                          deadline_s=max(
+                              t_sched + DEADLINES[name] - clock.now(),
+                              1e-9))
+            except ServerOverloaded:
+                pass            # accounted as shed(queue_full)
+            i += 1
+        rt.pump()
+        pool_sizes.append(rt.pool.size)
+    # drain the tail in virtual time, then force-flush stragglers
+    for _ in range(100_000):
+        if len(rt.queue) == 0:
+            break
+        if rt.pump() == 0:
+            ev = rt.next_event_t()
+            clock.advance(max((ev - clock.now()) if ev is not None
+                              else 0.05, 1e-9))
+    rt.drain()
+    # last completion may sit on a busy horizon past the host clock
+    duration = max([clock.now()]
+                   + [r.busy_until for r in rt.pool.replicas])
+
+    acct = rt.accounting()
+    snap = rt.snapshot()
+    met = snap["metrics"]
+    done_in_deadline = (met["completed"]
+                        - met["deadline_misses_completed_late"])
+    per_model = {name: rt.metrics.model_snapshot(name)
+                 for name in sorted(rt.models)}
+    summary = {
+        "accounting": acct,
+        "duration_s": round(duration, 6),
+        # goodput over the OFFERED window (the trace day) — both arms
+        # divide by the same denominator, so the comparison is purely
+        # deadline-met requests at equal offered load
+        "goodput_rps": round(done_in_deadline / trace["day_s"], 6),
+        "drain_tail_s": round(duration - trace["day_s"], 6),
+        "deadline_met": int(done_in_deadline),
+        "deadline_miss_rate": met["deadline_miss_rate"],
+        "shed_total": met["shed_total"],
+        "completed": met["completed"],
+        "mean_batch_fill": met["mean_batch_fill"],
+        "per_model": per_model,
+        "pool": {
+            "initial": n_replicas,
+            "min": int(min(pool_sizes)),
+            "max": int(max(pool_sizes)),
+            "final": rt.pool.size,
+            "cold_compiles": rt.pool.cold_compiles,
+        },
+        "slo": {"trips": snap["slo"]["trips"],
+                "decisions": snap["slo"]["decisions"],
+                "peak_burns": snap["slo"]["peak_burns"]},
+        "ladder_tiers_final": {m: rt.ladders[m].tier
+                               for m in sorted(rt.ladders)},
+        "model_weights_final": {m: rt.batcher.model_weight(m)
+                                for m in sorted(rt.models)},
+    }
+    if autoscale:
+        a = scaler.snapshot()
+        summary["autoscale"] = {
+            "grows": a["grows"], "shrinks": a["shrinks"],
+            "decisions": a["decisions"],
+            "actions": a["actions"][:64],
+            "prewarm": prewarm,
+        }
+        summary["resize_events"] = [
+            e for e in rt.pool.events
+            if e["kind"] in ("replica_joined", "replica_prewarmed",
+                             "replica_draining", "replica_retired")][:128]
+    return summary
+
+
+def digest(summary) -> str:
+    return hashlib.sha256(json.dumps(
+        summary, sort_keys=True).encode()).hexdigest()
+
+
+def run_twice(trace, configs, **kw):
+    """Every scenario runs twice from the same seed — the artifact
+    banks that the replay was byte-identical (OBS_r02 discipline)."""
+    a = run_scenario(trace, configs, **kw)
+    b = run_scenario(trace, configs, **kw)
+    da, db = digest(a), digest(b)
+    return a, {"digest": da, "replay_identical": da == db}
+
+
+# ---------------------------------------------------------------------------
+# The drill
+# ---------------------------------------------------------------------------
+
+
+def fleet_drill(seed: int, smoke: bool = False,
+                scale: int = 1) -> dict:
+    scale = (100 if smoke else 1) * scale
+    n = N_REQUESTS // scale
+    day_s = n / MEAN_RATE
+    configs = build_model_set(seed)
+    trace = build_trace(seed, n, day_s, burst=True)
+
+    static, static_replay = run_twice(
+        trace, configs, autoscale=False, n_replicas=STATIC_REPLICAS)
+    auto, auto_replay = run_twice(
+        trace, configs, autoscale=True, n_replicas=STATIC_REPLICAS)
+
+    # pre-warm A/B sub-phase: a burst-heavy slice at equal policy — the
+    # cold arm pays compile_s per first-dispatch geometry on the hot
+    # path.  The smoke slice keeps enough virtual seconds for the SLO
+    # windows + policy loop to actually trip inside the run.
+    sub_n = n // 8 if not smoke else max(n // 2, 4000)
+    sub_trace = build_trace(seed + 1, sub_n, sub_n / MEAN_RATE,
+                            burst=True)
+    warm, warm_replay = run_twice(
+        sub_trace, configs, autoscale=True, prewarm=True,
+        n_replicas=AUTOSCALE["min_replicas"])
+    cold, cold_replay = run_twice(
+        sub_trace, configs, autoscale=True, prewarm=False,
+        n_replicas=AUTOSCALE["min_replicas"])
+
+    checks = {
+        "static_zero_unaccounted":
+            static["accounting"]["unaccounted"] == 0,
+        "autoscaled_zero_unaccounted":
+            auto["accounting"]["unaccounted"] == 0,
+        "equal_trace_both_arms": (
+            static["accounting"]["submitted"] == n
+            and auto["accounting"]["submitted"] == n),
+        # the headline A/B needs the full-length day (prewarm and the
+        # SLO windows are fixed virtual seconds — a compressed smoke
+        # day is mostly lag); the committed full-scale artifact plus
+        # its claims test in tests/test_tools.py carry these strictly
+        "autoscaled_goodput_beats_static": (
+            auto["goodput_rps"] > static["goodput_rps"] or smoke),
+        "autoscaled_miss_rate_strictly_lower": (
+            auto["deadline_miss_rate"] < static["deadline_miss_rate"]
+            or smoke),
+        "autoscaler_grew": auto["autoscale"]["grows"] >= 1,
+        # the trough's shrink needs the full-length day to play out;
+        # the smoke trace is too short for the shrink hysteresis
+        "autoscaler_shrank": (auto["autoscale"]["shrinks"] >= 1
+                              or smoke),
+        "prewarm_no_cold_compiles":
+            warm["pool"]["cold_compiles"] == 0,
+        "cold_arm_paid_compile_tax":
+            cold["pool"]["cold_compiles"] > 0,
+        # the compressed smoke slice can end mid-burst where either arm
+        # may lead; the full-length sub-phase carries the claim
+        "prewarm_miss_rate_not_worse": (
+            warm["deadline_miss_rate"] <= cold["deadline_miss_rate"]
+            or smoke),
+        "replay_identical_all_scenarios": all(
+            r["replay_identical"] for r in
+            (static_replay, auto_replay, warm_replay, cold_replay)),
+    }
+    return {
+        "config": {
+            "n_requests": n, "day_s": round(day_s, 3),
+            "mean_rate_rps": MEAN_RATE, "diurnal_amp": DIURNAL_AMP,
+            "burst_x": BURST_X, "burst_window_frac": list(BURST_WINDOW),
+            "model_mix": {m: p for m, p in MODEL_MIX},
+            "deadlines_s": DEADLINES,
+            "service_s_per_batch_tier0": SERVICE,
+            "tier_speeds": {m: list(v) for m, v in TIER_SPEEDS.items()},
+            "ds2_bucket_edges": list(DS2_EDGES),
+            "max_batch": MAX_BATCH, "queue_capacity": QUEUE_CAPACITY,
+            "decision_every_batches": DECISION_EVERY,
+            "compile_s_per_geometry": COMPILE_S,
+            "geometries_per_replica": geometry_count(configs),
+            "static_replicas": STATIC_REPLICAS,
+            "autoscale_policy": dict(AUTOSCALE),
+            "slo_time_scale": 0.01,
+            "trace_sha256": trace_digest(trace),
+            "subphase_trace_sha256": trace_digest(sub_trace),
+            "subphase_n_requests": sub_n,
+        },
+        "static_pool": {**static, "replay": static_replay},
+        "autoscaled": {**auto, "replay": auto_replay},
+        "prewarm_subphase": {
+            "on": {**warm, "replay": warm_replay},
+            "off": {**cold, "replay": cold_replay},
+            "cold_compile_tax_s": round(
+                cold["pool"]["cold_compiles"] * COMPILE_S, 6),
+            "miss_rate_delta_off_minus_on": (
+                round(cold["deadline_miss_rate"]
+                      - warm["deadline_miss_rate"], 6)),
+        },
+        "headline": {
+            "goodput_rps": {"static": static["goodput_rps"],
+                            "autoscaled": auto["goodput_rps"]},
+            "deadline_miss_rate": {
+                "static": static["deadline_miss_rate"],
+                "autoscaled": auto["deadline_miss_rate"]},
+            "goodput_gain": round(
+                auto["goodput_rps"] / max(static["goodput_rps"], 1e-9),
+                4),
+        },
+        "checks": {"ok": all(checks.values()), **checks},
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default=f"SERVING_SCALE_{REVISION}.json")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI-sized run (~5k requests, seconds)")
+    ap.add_argument("--scale", type=int, default=1,
+                    help="extra divisor on the request count")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from analytics_zoo_tpu.obs import run_metadata
+
+    result = fleet_drill(args.seed, args.smoke, args.scale)
+    report = {
+        "drill": "serve_fleet_drill",
+        "revision": REVISION,
+        "seed": args.seed,
+        "smoke": bool(args.smoke),
+        "run_metadata": run_metadata("serve_fleet_drill", seed=args.seed,
+                                     extra={"smoke": bool(args.smoke),
+                                            "scale": args.scale}),
+        **result,
+        "verdict": "PASS" if result["checks"]["ok"] else "FAIL",
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    h = report["headline"]
+    p = report["prewarm_subphase"]
+    print(f"fleet drill: {report['verdict']} — "
+          f"{report['config']['n_requests']} requests/arm; goodput "
+          f"{h['goodput_rps']['static']:.1f} -> "
+          f"{h['goodput_rps']['autoscaled']:.1f} req/s "
+          f"({h['goodput_gain']:.2f}x), miss rate "
+          f"{h['deadline_miss_rate']['static']:.4f} -> "
+          f"{h['deadline_miss_rate']['autoscaled']:.4f}; cold-compile "
+          f"tax {p['cold_compile_tax_s']:.1f}s "
+          f"({p['off']['pool']['cold_compiles']} cold compiles, "
+          f"miss delta {p['miss_rate_delta_off_minus_on']:+.4f}); "
+          f"wrote {args.out}")
+    return 0 if report["verdict"] == "PASS" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
