@@ -116,6 +116,120 @@ def _fraud(mesh) -> List[AuditProgram]:
             AuditProgram("fraud/eval", build_eval)]
 
 
+def _rec(mesh) -> List[AuditProgram]:
+    # web-scale recommendation (ISSUE 17): the dedup'd-gather train and
+    # eval programs for BOTH family architectures — the sparse lookup +
+    # segment-sum backward is the hot path the audit must trace
+    U, I, CLS = 64, 48, 5
+
+    def build_train() -> BuiltProgram:
+        from analytics_zoo_tpu.core.criterion import ClassNLLCriterion
+        from analytics_zoo_tpu.models import NeuralCF
+        from analytics_zoo_tpu.parallel import (Adam, make_train_step,
+                                                pipeline_specs)
+
+        module = NeuralCF(n_users=U, n_items=I, embedding_dim=8,
+                          mf_embedding_dim=4, hidden=(16, 8), n_classes=CLS)
+        specs = pipeline_specs("rec", mesh=mesh)
+        optim = Adam(1e-3)
+        _, state = abstract_train_state(module, optim,
+                                        _S((1,), np.int32),
+                                        _S((1,), np.int32))
+        step = make_train_step(module, ClassNLLCriterion(), optim,
+                               specs=specs, state=state)
+        B = specs.data_axis_size
+        batch = {"input": (_S((B,), np.int32), _S((B,), np.int32)),
+                 "target": _S((B,), np.int32)}
+        return BuiltProgram(fn=step, args=(state, batch, 1.0),
+                            specs=specs, donate_state=state)
+
+    def build_wd_train() -> BuiltProgram:
+        from analytics_zoo_tpu.core.criterion import ClassNLLCriterion
+        from analytics_zoo_tpu.models import WideAndDeep
+        from analytics_zoo_tpu.parallel import (Adam, make_train_step,
+                                                pipeline_specs)
+
+        module = WideAndDeep(n_users=U, n_items=I, embedding_dim=8,
+                             hidden=(16, 8), n_classes=CLS,
+                             cross_buckets=32)
+        specs = pipeline_specs("rec", mesh=mesh)
+        optim = Adam(1e-3)
+        _, state = abstract_train_state(module, optim,
+                                        _S((1,), np.int32),
+                                        _S((1,), np.int32))
+        step = make_train_step(module, ClassNLLCriterion(), optim,
+                               specs=specs, state=state)
+        B = specs.data_axis_size
+        batch = {"input": (_S((B,), np.int32), _S((B,), np.int32)),
+                 "target": _S((B,), np.int32)}
+        return BuiltProgram(fn=step, args=(state, batch, 1.0),
+                            specs=specs, donate_state=state)
+
+    def build_eval() -> BuiltProgram:
+        from analytics_zoo_tpu.models import NeuralCF
+        from analytics_zoo_tpu.parallel import (make_eval_step,
+                                                pipeline_specs)
+
+        module = NeuralCF(n_users=U, n_items=I, embedding_dim=8,
+                          mf_embedding_dim=4, hidden=(16, 8), n_classes=CLS)
+        specs = pipeline_specs("rec", mesh=mesh)
+        variables = abstract_variables(module, _S((1,), np.int32),
+                                       _S((1,), np.int32))
+        ev = make_eval_step(module, specs=specs)
+        B = specs.data_axis_size
+        return BuiltProgram(fn=ev,
+                            args=(variables, (_S((B,), np.int32),
+                                              _S((B,), np.int32))),
+                            specs=specs)
+
+    return [AuditProgram("rec/train", build_train),
+            AuditProgram("rec-wd/train", build_wd_train),
+            AuditProgram("rec/eval", build_eval)]
+
+
+def _sentiment(mesh) -> List[AuditProgram]:
+    V, D, T = 256, 16, 24
+
+    def _module():
+        from analytics_zoo_tpu.models import SentimentNet
+
+        return SentimentNet(vocab_size=V, embedding_dim=D, hidden=8,
+                            head="gru")
+
+    def build_train() -> BuiltProgram:
+        from analytics_zoo_tpu.core.criterion import BCECriterion
+        from analytics_zoo_tpu.parallel import (Adam, make_train_step,
+                                                pipeline_specs)
+
+        module = _module()
+        specs = pipeline_specs("sentiment", mesh=mesh)
+        optim = Adam(1e-3)
+        _, state = abstract_train_state(module, optim,
+                                        _S((1, T), np.int32))
+        step = make_train_step(module, BCECriterion(), optim,
+                               specs=specs, state=state)
+        B = specs.data_axis_size
+        batch = {"input": _S((B, T), np.int32),
+                 "target": _S((B,), np.float32)}
+        return BuiltProgram(fn=step, args=(state, batch, 1.0),
+                            specs=specs, donate_state=state)
+
+    def build_eval() -> BuiltProgram:
+        from analytics_zoo_tpu.parallel import (make_eval_step,
+                                                pipeline_specs)
+
+        module = _module()
+        specs = pipeline_specs("sentiment", mesh=mesh)
+        variables = abstract_variables(module, _S((1, T), np.int32))
+        ev = make_eval_step(module, specs=specs)
+        B = specs.data_axis_size
+        return BuiltProgram(fn=ev, args=(variables, _S((B, T), np.int32)),
+                            specs=specs)
+
+    return [AuditProgram("sentiment/train", build_train),
+            AuditProgram("sentiment/eval", build_eval)]
+
+
 def _ds2(mesh) -> List[AuditProgram]:
     T, MELS, LAB = 32, 13, 4
 
@@ -447,6 +561,37 @@ def _fraud_serving(mesh) -> List[AuditProgram]:
     return _tier_targets("fraud", tiers, specs)
 
 
+def _rec_serving(mesh) -> List[AuditProgram]:
+    from analytics_zoo_tpu.parallel import pipeline_specs
+    from analytics_zoo_tpu.pipelines.recommendation import (
+        make_ncf_model, rec_serving_tiers)
+
+    # sized like the train targets; tiny enough that a real init is
+    # cheaper than the abstract+filled dance (int8 scales read values)
+    model = make_ncf_model(n_users=64, n_items=48, embedding_dim=8,
+                           mf_embedding_dim=4, hidden=(16, 8))
+    specs = pipeline_specs("rec", mesh=mesh)
+    tiers = rec_serving_tiers(model, specs=specs)
+    return _tier_targets("rec", tiers, specs)
+
+
+def _sentiment_serving(mesh) -> List[AuditProgram]:
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.models import SentimentNet
+    from analytics_zoo_tpu.parallel import pipeline_specs
+    from analytics_zoo_tpu.pipelines.sentiment import sentiment_serving_tiers
+
+    T = 24
+    module = SentimentNet(vocab_size=256, embedding_dim=16, hidden=8,
+                          head="gru")
+    model = Model(module)
+    model.variables = filled(abstract_variables(
+        module, _S((1, T), np.int32)))
+    specs = pipeline_specs("sentiment", mesh=mesh)
+    tiers = sentiment_serving_tiers(model, specs=specs, seq_len=T)
+    return _tier_targets("sentiment", tiers, specs)
+
+
 def _guarded_tiers(kind: str, builder, mesh) -> List[AuditProgram]:
     """The serving-tier targets need the tier FACTORIES to run before
     the target names are even known (names come from the rungs).  A
@@ -476,6 +621,10 @@ def repo_audit_suite(mesh=None) -> List[AuditProgram]:
     targets += _frcnn(mesh)
     targets += _ds2(mesh)
     targets += _fraud(mesh)
+    # the ISSUE-17 long tail: recommendation (NCF + Wide&Deep) and
+    # sentiment ride the sharded-embedding substrate
+    targets += _rec(mesh)
+    targets += _sentiment(mesh)
     targets += _guarded_tiers("ssd", _ssd_serving, mesh)
     targets += _guarded_tiers("ds2", _ds2_serving, mesh)
     # the ISSUE-14 multiplexed fleet: every model family the shared
@@ -483,4 +632,6 @@ def repo_audit_suite(mesh=None) -> List[AuditProgram]:
     targets += _guarded_tiers("ds2-stream", _ds2_streaming_serving, mesh)
     targets += _guarded_tiers("frcnn", _frcnn_serving, mesh)
     targets += _guarded_tiers("fraud", _fraud_serving, mesh)
+    targets += _guarded_tiers("rec", _rec_serving, mesh)
+    targets += _guarded_tiers("sentiment", _sentiment_serving, mesh)
     return targets

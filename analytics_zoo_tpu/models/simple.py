@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from analytics_zoo_tpu.core.rnn import BiRecurrent, GRUCell, LSTMCell, Recurrent
+from analytics_zoo_tpu.ops.embedding import DedupEmbed
 
 
 class FraudMLP(nn.Module):
@@ -43,6 +44,7 @@ class SentimentNet(nn.Module):
     ``head`` ∈ {"gru", "lstm", "bilstm", "cnn", "cnn-lstm"} — the notebook's
     selectable architectures.  ``embeddings`` (vocab, dim) freezes GloVe
     vectors when given; otherwise a trainable LookupTable is used.
+    ``lookup`` selects the embedding hot path (``ops.embedding``).
     """
 
     vocab_size: int = 20000
@@ -50,6 +52,7 @@ class SentimentNet(nn.Module):
     hidden: int = 128
     head: str = "gru"
     embeddings: Optional[jnp.ndarray] = None
+    lookup: str = "dedup"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -57,8 +60,9 @@ class SentimentNet(nn.Module):
             table = jnp.asarray(self.embeddings)
             emb = table[x.astype(jnp.int32)]
         else:
-            emb = nn.Embed(self.vocab_size, self.embedding_dim,
-                           name="embed")(x.astype(jnp.int32))
+            emb = DedupEmbed(self.vocab_size, self.embedding_dim,
+                             lookup=self.lookup,
+                             name="embed")(x.astype(jnp.int32))
         h = emb                                           # (B, T, D)
         if self.head == "gru":
             h = Recurrent(cell=GRUCell(hidden_size=self.hidden))(h)[:, -1]
@@ -99,28 +103,32 @@ class WideAndDeep(nn.Module):
     hidden: Sequence[int] = (40, 20)
     n_classes: int = 5
     cross_buckets: int = 1000
+    lookup: str = "dedup"
 
     @nn.compact
     def __call__(self, users, items):
         users = users.astype(jnp.int32)
         items = items.astype(jnp.int32)
         zeros = nn.initializers.zeros
+
+        def embed(vocab, dim, name, init=None):
+            kw = {"embedding_init": init} if init is not None else {}
+            return DedupEmbed(vocab, dim, lookup=self.lookup, name=name, **kw)
+
         # wide: w_user[u] + w_item[i] + w_cross[hash(u, i)] + b
         # (multiplicative hash in wrapping uint32, then bucket)
         cross = ((users.astype(jnp.uint32) * jnp.uint32(2654435761)
                   + items.astype(jnp.uint32))
                  % jnp.uint32(self.cross_buckets)).astype(jnp.int32)
         wide = (
-            nn.Embed(self.n_users, self.n_classes, name="wide_user",
-                     embedding_init=zeros)(users)
-            + nn.Embed(self.n_items, self.n_classes, name="wide_item",
-                       embedding_init=zeros)(items)
-            + nn.Embed(self.cross_buckets, self.n_classes, name="wide_cross",
-                       embedding_init=zeros)(cross)
+            embed(self.n_users, self.n_classes, "wide_user", zeros)(users)
+            + embed(self.n_items, self.n_classes, "wide_item", zeros)(items)
+            + embed(self.cross_buckets, self.n_classes, "wide_cross",
+                    zeros)(cross)
         )
         # deep: embedding concat → MLP
-        u = nn.Embed(self.n_users, self.embedding_dim, name="user_embed")(users)
-        v = nn.Embed(self.n_items, self.embedding_dim, name="item_embed")(items)
+        u = embed(self.n_users, self.embedding_dim, "user_embed")(users)
+        v = embed(self.n_items, self.embedding_dim, "item_embed")(items)
         h = jnp.concatenate([u, v], axis=-1)
         for i, width in enumerate(self.hidden):
             h = nn.relu(nn.Dense(width, name=f"fc{i}")(h))
@@ -145,21 +153,24 @@ class NeuralCF(nn.Module):
     hidden: Sequence[int] = (40, 20)
     n_classes: int = 5
     include_mf: bool = True
+    lookup: str = "dedup"
 
     @nn.compact
     def __call__(self, users, items):
         users = users.astype(jnp.int32)
         items = items.astype(jnp.int32)
-        u = nn.Embed(self.n_users, self.embedding_dim, name="user_embed")(users)
-        v = nn.Embed(self.n_items, self.embedding_dim, name="item_embed")(items)
+        u = DedupEmbed(self.n_users, self.embedding_dim, lookup=self.lookup,
+                       name="user_embed")(users)
+        v = DedupEmbed(self.n_items, self.embedding_dim, lookup=self.lookup,
+                       name="item_embed")(items)
         h = jnp.concatenate([u, v], axis=-1)
         for i, width in enumerate(self.hidden):
             h = nn.relu(nn.Dense(width, name=f"fc{i}")(h))
         if self.include_mf:
-            mu = nn.Embed(self.n_users, self.mf_embedding_dim,
-                          name="mf_user_embed")(users)
-            mv = nn.Embed(self.n_items, self.mf_embedding_dim,
-                          name="mf_item_embed")(items)
+            mu = DedupEmbed(self.n_users, self.mf_embedding_dim,
+                            lookup=self.lookup, name="mf_user_embed")(users)
+            mv = DedupEmbed(self.n_items, self.mf_embedding_dim,
+                            lookup=self.lookup, name="mf_item_embed")(items)
             h = jnp.concatenate([mu * mv, h], axis=-1)
         h = nn.Dense(self.n_classes, name="out")(h)
         return jax.nn.log_softmax(h, axis=-1)

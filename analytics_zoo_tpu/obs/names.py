@@ -111,6 +111,15 @@ CATALOG: Dict[str, str] = {
         "atomic publish)",
     "checkpoint/restore_s":
         "histogram · checkpoint restore wall seconds",
+    # -- embedding lookups (ops.embedding.publish_lookup_stats) -------------
+    "embed/lookups":
+        "counter · id batches whose dedup stats were published",
+    "embed/rows_touched":
+        "gauge · unique table rows the last id batch gathered (what the "
+        "dedup'd lookup actually fetches; the sparse apply's row count)",
+    "embed/unique_fraction":
+        "gauge · unique/total id ratio of the last batch (the dedup "
+        "win: Zipfian traffic sits well below 1.0)",
     # -- data loading (ReadStats.publish) -----------------------------------
     "data/read/records":
         "gauge · records successfully yielded by resilient shard reads",

@@ -34,6 +34,19 @@ from analytics_zoo_tpu.ops.pallas_rnn import (
     persistent_vmem_bytes,
 )
 from analytics_zoo_tpu.ops.anchor import generate_base_anchors, shift_anchors
+from analytics_zoo_tpu.ops.embedding import (
+    LOOKUP_MODES,
+    DedupEmbed,
+    SparseRows,
+    dedup_lookup,
+    embedding_grad_rows,
+    lookup_stats,
+    naive_lookup,
+    onehot_lookup,
+    publish_lookup_stats,
+    sharded_embedding_lookup,
+    sparse_rows_to_dense,
+)
 from analytics_zoo_tpu.ops.proposal import ProposalParam, proposal
 from analytics_zoo_tpu.ops.roi_pool import roi_pool, roi_pool_batch
 

@@ -1,0 +1,224 @@
+"""Sharded embedding substrate: dedup'd gather with a segment-sum backward.
+
+The recommendation/sentiment families (NCF, Wide&Deep, GloVe sentiment —
+the reference zoo's ``apps/`` long tail) stress the one scale axis the
+dense pipelines never touch: lookup tables too large for one chip's HBM,
+where the hot path is a sparse gather/scatter rather than a matmul.  The
+reference expresses a lookup as ``LookupTable`` (BigDL) — a one-hot
+matmul whose backward *densifies* the cotangent to a full
+``(vocab, dim)`` matrix.  That is exactly what does not scale.  This
+module is the embedding dialect of the declare-once substrate:
+
+* **dedup'd forward** — real-world id streams are Zipfian, so a batch
+  references far fewer unique rows than it has positions.
+  :func:`dedup_lookup` gathers each unique id ONCE
+  (``jnp.unique(..., size=N)`` keeps the shape static under jit) and
+  inverts back to batch positions with a second cheap gather.
+* **segment-sum backward** — a ``custom_vjp`` whose backward sorts the
+  inverse map and ``segment_sum``s the output cotangent into per-unique
+  rows (``(ids, rows)`` — :class:`SparseRows`), then lands them with a
+  single ``vocab``-sized scatter-add.  No one-hot matmul, no
+  ``(batch, vocab)`` intermediate, ever.
+* **sharding-neutral routing** — :func:`sharded_embedding_lookup` is a
+  plain gather at trace time; when the table is row-sharded by the
+  SpecSet rules (``parallel.tensor.embedding_row_rules`` — vocab dim 0
+  over the ``model`` axis), XLA's SPMD partitioner turns it into a
+  shard-local gather plus the substrate's collectives, which the
+  az-analyze jaxpr audit checks against the declared mesh like every
+  other program.  No manual collective appears here.
+* **sparse optimizer apply** — the training-side twin lives in
+  ``parallel.train.sparse_adam_apply``: only touched rows and their
+  Adam slots move, fed by :func:`embedding_grad_rows`.
+
+``tests/test_embedding.py`` pins forward/backward parity (≤1e-5) of the
+dedup path against the dense one-hot reference for every embedding model
+in the zoo, repeated/ragged id batches included.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LOOKUP_MODES = ("dedup", "naive", "onehot")
+
+# flax's nn.Embed default initializer, so swapping a model between
+# nn.Embed and DedupEmbed is weight-distribution (and checkpoint-path)
+# neutral.
+default_embed_init = nn.initializers.variance_scaling(
+    1.0, "fan_in", "normal", out_axis=0)
+
+
+class SparseRows(NamedTuple):
+    """A row-sparse embedding gradient: ``rows[k]`` is the segment-summed
+    cotangent for ``ids[k]``.  ``ids`` is the sorted unique-id vector
+    padded (with the fill id) to its static ``size``; ``count`` is the
+    number of leading entries that are real.  Padded entries carry
+    all-zero rows, so scatter-ADDs may ignore ``count``; scatter-SETs
+    (the optimizer apply) must mask by it."""
+
+    ids: jax.Array    # (size,) int32, sorted unique ids, fill-padded
+    rows: jax.Array   # (size, dim) segment-summed rows, zero-padded
+    count: jax.Array  # ()  int32, number of valid unique ids
+
+
+def _flat_ids(ids: jax.Array) -> jax.Array:
+    return ids.reshape(-1).astype(jnp.int32)
+
+
+def _unique(flat: jax.Array, size: int):
+    """Static-shape unique: sorted ids padded with 0, inverse map, and
+    the valid-unique count (padding slots have count 0)."""
+    uids, inv, counts = jnp.unique(flat, size=size, fill_value=0,
+                                   return_inverse=True, return_counts=True)
+    return uids, inv.reshape(-1), jnp.sum(counts > 0).astype(jnp.int32)
+
+
+def _segment_rows(g: jax.Array, inv: jax.Array, size: int) -> jax.Array:
+    """Sorted ``segment_sum`` of the flattened cotangent into per-unique
+    rows — the (ids, rows) half of the backward."""
+    gf = g.reshape(-1, g.shape[-1])
+    order = jnp.argsort(inv)
+    return jax.ops.segment_sum(gf[order], inv[order], num_segments=size,
+                               indices_are_sorted=True)
+
+
+def naive_lookup(table: jax.Array, ids: jax.Array) -> jax.Array:
+    """Plain gather — one row fetch per batch POSITION (duplicates pay
+    full price; backward is XLA's per-position scatter-add)."""
+    return table[_flat_ids(ids)].reshape(ids.shape + (table.shape[-1],))
+
+
+def onehot_lookup(table: jax.Array, ids: jax.Array) -> jax.Array:
+    """The reference semantics: ``one_hot(ids) @ table``.  Forward
+    materializes a ``(positions, vocab)`` matrix and the vjp densifies
+    the cotangent to ``(vocab, dim)`` via the transposed matmul — the
+    parity baseline the dedup path is tested (and benched) against."""
+    oh = jax.nn.one_hot(_flat_ids(ids), table.shape[0], dtype=table.dtype)
+    return (oh @ table).reshape(ids.shape + (table.shape[-1],))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _dedup_lookup(table, ids, size, vocab):
+    out, _ = _dedup_fwd(table, ids, size, vocab)
+    return out
+
+
+def _dedup_fwd(table, ids, size, vocab):
+    flat = _flat_ids(ids)
+    uids, inv, _ = _unique(flat, size)
+    rows = table[uids]                       # ONE gather per unique id
+    out = rows[inv].reshape(ids.shape + (table.shape[-1],))
+    return out, (uids, inv)
+
+
+def _dedup_bwd(size, vocab, res, g):
+    uids, inv = res
+    srows = _segment_rows(g, inv, size)      # (ids, rows) sparse grad
+    # one scatter-add lands the unique rows; padded slots add zeros to
+    # row 0, which is a no-op.  No (batch, vocab) one-hot appears.
+    table_ct = jnp.zeros((vocab, g.shape[-1]), srows.dtype).at[uids].add(srows)
+    ids_ct = np.zeros((), dtype=jax.dtypes.float0)  # int ids: no tangent
+    return table_ct, ids_ct
+
+
+_dedup_lookup.defvjp(_dedup_fwd, _dedup_bwd)
+
+
+def dedup_lookup(table: jax.Array, ids: jax.Array, *,
+                 max_unique: Optional[int] = None) -> jax.Array:
+    """Unique-id-dedup'd embedding lookup with the segment-sum backward.
+
+    ``max_unique`` caps the static unique-id buffer (default: one slot
+    per batch position — always enough).  Shapes are static, so the
+    whole path jits; under a row-sharded table the partitioner routes it
+    shard-local."""
+    size = int(max_unique) if max_unique else max(int(np.prod(ids.shape)), 1)
+    return _dedup_lookup(table, ids, size, int(table.shape[0]))
+
+
+def sharded_embedding_lookup(table: jax.Array, ids: jax.Array, *,
+                             mode: str = "dedup",
+                             max_unique: Optional[int] = None) -> jax.Array:
+    """The substrate entry point: ``ids (...,) → (..., dim)``.
+
+    ``mode`` selects the hot path — ``"dedup"`` (production), ``"naive"``
+    (per-position gather), ``"onehot"`` (the densifying reference) — so
+    benches and parity tests swap implementations without touching the
+    model.  Row sharding is NOT handled here: declare it once via the
+    SpecSet rules and the SPMD partitioner splits the gather."""
+    if mode == "dedup":
+        return dedup_lookup(table, ids, max_unique=max_unique)
+    if mode == "naive":
+        return naive_lookup(table, ids)
+    if mode == "onehot":
+        return onehot_lookup(table, ids)
+    raise ValueError(f"unknown lookup mode {mode!r} (one of {LOOKUP_MODES})")
+
+
+def embedding_grad_rows(ids: jax.Array, cotangent: jax.Array, *,
+                        max_unique: Optional[int] = None) -> SparseRows:
+    """The sparse gradient itself: segment-sum ``cotangent`` (the output
+    grad, shaped ``ids.shape + (dim,)``) into :class:`SparseRows` —
+    what ``parallel.train.sparse_adam_apply`` consumes instead of a
+    ``(vocab, dim)`` dense table gradient."""
+    size = int(max_unique) if max_unique else max(int(np.prod(ids.shape)), 1)
+    uids, inv, count = _unique(_flat_ids(ids), size)
+    return SparseRows(ids=uids, rows=_segment_rows(cotangent, inv, size),
+                      count=count)
+
+
+def sparse_rows_to_dense(grad: SparseRows, vocab: int) -> jax.Array:
+    """Densify a :class:`SparseRows` gradient (tests/debug only — the
+    point of the sparse path is to never need this in training)."""
+    return jnp.zeros((vocab, grad.rows.shape[-1]),
+                     grad.rows.dtype).at[grad.ids].add(grad.rows)
+
+
+class DedupEmbed(nn.Module):
+    """Drop-in ``nn.Embed`` with a selectable lookup hot path.
+
+    The parameter keeps flax's name (``embedding``) and initializer, so
+    param paths, checkpoints, the int8 quantization pattern
+    (``(kernel|embedding)$``) and the row-sharding rules all apply
+    unchanged; only the gather/backward implementation is swapped via
+    ``lookup`` ∈ ``LOOKUP_MODES``."""
+
+    num_embeddings: int
+    features: int
+    lookup: str = "dedup"
+    embedding_init: Callable[..., Any] = default_embed_init
+
+    @nn.compact
+    def __call__(self, ids: jax.Array) -> jax.Array:
+        table = self.param("embedding", self.embedding_init,
+                           (self.num_embeddings, self.features))
+        return sharded_embedding_lookup(table, ids, mode=self.lookup)
+
+
+def lookup_stats(ids: Any) -> dict:
+    """Host-side dedup telemetry for one batch of ids: how sparse was
+    the lookup actually?  ``unique_fraction`` is the direct win ratio of
+    the dedup'd gather (rows fetched / positions)."""
+    flat = np.asarray(ids).reshape(-1)
+    unique = int(np.unique(flat).size)
+    return {
+        "positions": int(flat.size),
+        "rows_touched": unique,
+        "unique_fraction": float(unique / max(flat.size, 1)),
+    }
+
+
+def publish_lookup_stats(registry: Any, ids: Any) -> dict:
+    """Register one batch's dedup stats into a ``MetricRegistry``
+    (names declared in ``obs/names.py``)."""
+    stats = lookup_stats(ids)
+    registry.counter("embed/lookups").inc()
+    registry.gauge("embed/rows_touched").set(stats["rows_touched"])
+    registry.gauge("embed/unique_fraction").set(stats["unique_fraction"])
+    return stats

@@ -39,6 +39,7 @@ from analytics_zoo_tpu.parallel.train import (
     create_train_state,
     make_eval_step,
     make_train_step,
+    sparse_adam_apply,
     state_to_variables,
     validate,
 )
@@ -68,6 +69,7 @@ from analytics_zoo_tpu.parallel.pipeline import (
 )
 from analytics_zoo_tpu.parallel.tensor import (
     default_tp_rules,
+    embedding_row_rules,
     megatron_tp_rules,
     spatial_input_spec,
     ssd_tp_rules,

@@ -268,3 +268,28 @@ def _ds2_specs(mesh: Mesh, param_rules: Optional[Sequence] = None
 def _fraud_specs(mesh: Mesh) -> SpecSet:
     """Fraud-detection MLP: pure data parallel."""
     return SpecSet(mesh)
+
+
+@register_pipeline("rec")
+def _rec_specs(mesh: Mesh, shard_tables: bool = True) -> SpecSet:
+    """Recommendation (NeuralCF / Wide&Deep): data-parallel batches with
+    every ``(vocab, dim)`` lookup table ROW-sharded over ``model`` when
+    the mesh declares that axis (``tensor.embedding_row_rules`` — each
+    device owns an id range; the lookup compiles to a shard-local gather
+    plus the partitioner's collectives).  On a pure data mesh the rule
+    degrades to replicated, so the same declaration serves both."""
+    from analytics_zoo_tpu.parallel import tensor as tensor_lib
+
+    rules = tensor_lib.embedding_row_rules() if shard_tables else None
+    return SpecSet(mesh, rules=rules)
+
+
+@register_pipeline("sentiment")
+def _sentiment_specs(mesh: Mesh, shard_tables: bool = True) -> SpecSet:
+    """Sentiment heads over a GloVe-scale vocab table: same embedding
+    row-sharding declaration as ``rec`` (the table dominates the model's
+    parameter count; the recurrent/conv head stays replicated)."""
+    from analytics_zoo_tpu.parallel import tensor as tensor_lib
+
+    rules = tensor_lib.embedding_row_rules() if shard_tables else None
+    return SpecSet(mesh, rules=rules)

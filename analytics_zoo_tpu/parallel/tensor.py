@@ -57,11 +57,38 @@ def _contract_dim(axis: str):
     return spec
 
 
+def _row_dim(axis: str):
+    """Shard dim 0 — the VOCAB dim of an Embed (vocab, features) table.
+    Row sharding is what large lookup tables want: each device owns a
+    contiguous id range and a lookup is a shard-local gather (the SPMD
+    partitioner inserts the combine), whereas column sharding splits
+    every row's features and makes EVERY lookup touch EVERY device."""
+    def spec(shape):
+        axes: List[Optional[str]] = [None] * len(shape)
+        axes[0] = axis
+        return axes
+    return spec
+
+
+def embedding_row_rules(axis: str = MODEL_AXIS) -> List[Rule]:
+    """Row-shard every ``embedding`` table over ``axis`` (vocab dim 0).
+    The rule a pipeline's ``param_rules`` prepends for large-vocab
+    lookup tables; optimizer slots mirror it through their sub-paths."""
+    return [
+        (r"(^|.*/)embedding$", _row_dim(axis)),
+    ]
+
+
 def default_tp_rules(axis: str = MODEL_AXIS) -> List[Rule]:
     """Megatron-style column sharding of every learnable matrix's output
-    features; biases/scales stay replicated (1-D, tiny)."""
-    return [
-        (r"(^|.*/)(kernel|embedding)$", _last_dim(axis)),
+    features; biases/scales stay replicated (1-D, tiny).  Embedding
+    tables take the ROW rule first: a (vocab, dim) table column-sharded
+    on dim 1 (the pre-ISSUE-17 behavior of the generic rule below) puts
+    a slice of every row on every device, which is the wrong axis for
+    large vocabularies — first-match precedence routes them to
+    ``embedding_row_rules`` instead."""
+    return embedding_row_rules(axis) + [
+        (r"(^|.*/)kernel$", _last_dim(axis)),
     ]
 
 
@@ -176,9 +203,14 @@ def spec_tree(tree: Any, mesh: Mesh,
     def resolve(path_entries, leaf):
         path = "/".join(str(getattr(e, "key", getattr(e, "name", e)))
                         for e in path_entries)
-        arr = np.asarray(leaf) if not isinstance(leaf, jax.Array) else leaf
-        return (partition_spec(path, arr.shape, mesh, rules)
-                if getattr(arr, "ndim", 0) > 0 else P())
+        # read .shape where the leaf carries one (arrays AND abstract
+        # ShapeDtypeStructs — the az-analyze audit resolves specs over
+        # eval_shape trees); only coerce true scalars/lists through numpy
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            shape = np.asarray(leaf).shape
+        return (partition_spec(path, tuple(shape), mesh, rules)
+                if len(shape) > 0 else P())
 
     return jax.tree_util.tree_map_with_path(resolve, tree)
 

@@ -1405,6 +1405,47 @@ def _batch_size(batch) -> int:
     return int(shape[0]) if shape else int(np.asarray(leaf).shape[0])
 
 
+def sparse_adam_apply(table, mu, nu, count, grad, learning_rate,
+                      b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8):
+    """Row-sparse Adam: update ONLY the rows a batch touched, and only
+    their optimizer slots — the embedding-table apply that scales past
+    one chip (a full-table apply moves ``vocab × dim`` for every step no
+    matter how few rows the batch referenced).
+
+    ``grad`` is an ``ops.embedding.SparseRows`` (the (ids, segment-summed
+    rows) gradient the dedup'd lookup backward produces);
+    ``mu``/``nu``/``count`` are the table's Adam slots.  The math runs
+    the SAME optax transforms as the full-table path
+    (``scale_by_adam`` → ``scale(-lr)`` → ``p + u``) on the gathered
+    rows, so touched rows bit-match a dense ``optax.adam`` apply —
+    ``tests/test_embedding.py`` pins this.  Untouched rows keep stale
+    moments (lazy Adam): their ``mu``/``nu`` do not decay until the next
+    time they are touched, the standard sparse-trainer tradeoff.
+
+    Padded tail entries of ``grad.ids`` are redirected OUT OF BOUNDS:
+    jax gathers clamp (harmless garbage rows in dead slots) and jax
+    scatters DROP out-of-bounds updates, so padding never corrupts row
+    0 and valid unique ids make every scatter-set deterministic.
+
+    Returns ``(table, mu, nu, count)`` updated."""
+    vocab = table.shape[0]
+    n = grad.ids.shape[0]
+    valid = jnp.arange(n, dtype=jnp.int32) < grad.count
+    safe_ids = jnp.where(valid, grad.ids, vocab)
+    t_rows, mu_rows, nu_rows = table[safe_ids], mu[safe_ids], nu[safe_ids]
+    adam = optax.scale_by_adam(b1=b1, b2=b2, eps=eps)
+    row_state = optax.ScaleByAdamState(count=count, mu=mu_rows, nu=nu_rows)
+    upd, new_state = adam.update(grad.rows, row_state, t_rows)
+    # mirror optax.scale_by_learning_rate + apply_updates op-for-op so
+    # the arithmetic is bit-identical to the dense chain
+    step_size = -1 * jnp.asarray(learning_rate, dtype=jnp.float32)
+    new_rows = (t_rows + step_size * upd).astype(table.dtype)
+    return (table.at[safe_ids].set(new_rows),
+            mu.at[safe_ids].set(new_state.mu.astype(mu.dtype)),
+            nu.at[safe_ids].set(new_state.nu.astype(nu.dtype)),
+            new_state.count)
+
+
 def validate(module, variables, dataset, methods: Sequence[ValidationMethod],
              eval_step=None) -> List[ValidationResult]:
     """Forward a dataset and monoid-reduce validation results (reference

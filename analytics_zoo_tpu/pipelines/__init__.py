@@ -57,6 +57,20 @@ from analytics_zoo_tpu.pipelines.fraud import (
     precision_recall,
     run_fraud_pipeline,
 )
+from analytics_zoo_tpu.pipelines.recommendation import (
+    make_ncf_model,
+    make_wide_deep_model,
+    predict_ratings,
+    rating_batches,
+    rec_serving_tiers,
+    train_recommender,
+)
+from analytics_zoo_tpu.pipelines.sentiment import (
+    make_sentiment_model,
+    review_batches,
+    sentiment_serving_tiers,
+    train_sentiment,
+)
 from analytics_zoo_tpu.pipelines.visualizer import result_to_string, vis_detection
 from analytics_zoo_tpu.pipelines.deepspeech2 import (
     DS2Param,

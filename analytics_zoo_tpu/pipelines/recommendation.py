@@ -1,0 +1,151 @@
+"""Recommendation pipeline: NCF + Wide&Deep on the sharded-embedding substrate.
+
+Port of the reference's ``apps/recommendation`` notebooks
+(``recommender-explicit-feedback.ipynb``: user/item LookupTables →
+JoinTable → MLP → LogSoftMax over 5 rating classes) plus the family's
+second architecture, Wide&Deep.  This is the web-scale family: the model
+is dominated by ``(vocab, dim)`` lookup tables, the hot path is the
+dedup'd gather of ``ops.embedding`` (the models default to
+``lookup="dedup"``), and the declared specs (``pipeline_specs("rec")``)
+row-shard every table over the ``model`` mesh axis when one exists.
+
+Training follows the fraud pipeline's shape — ``Optimizer`` over
+``{"input": (users, items), "target": rating_class}`` batches with
+sharding declared once through the spec registry — and
+:func:`rec_serving_tiers` hands the fleet runtime the same fp/int8
+degradation rungs as every other multiplexed family.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.core.criterion import ClassNLLCriterion
+from analytics_zoo_tpu.core.module import Model
+from analytics_zoo_tpu.models import NeuralCF, WideAndDeep
+from analytics_zoo_tpu.parallel import Adam, Optimizer, Trigger, pipeline_specs
+
+
+def make_ncf_model(n_users: int = 1000, n_items: int = 1000,
+                   embedding_dim: int = 20, mf_embedding_dim: int = 8,
+                   hidden: Sequence[int] = (40, 20), n_classes: int = 5,
+                   include_mf: bool = True, lookup: str = "dedup",
+                   seed: int = 0) -> Model:
+    """Built NeuralCF :class:`Model` (params initialized)."""
+    model = Model(NeuralCF(n_users=n_users, n_items=n_items,
+                           embedding_dim=embedding_dim,
+                           mf_embedding_dim=mf_embedding_dim,
+                           hidden=tuple(hidden), n_classes=n_classes,
+                           include_mf=include_mf, lookup=lookup))
+    probe = jnp.zeros((1,), jnp.int32)
+    model.build(seed, probe, probe)
+    return model
+
+
+def make_wide_deep_model(n_users: int = 1000, n_items: int = 1000,
+                         embedding_dim: int = 20,
+                         hidden: Sequence[int] = (40, 20),
+                         n_classes: int = 5, cross_buckets: int = 1000,
+                         lookup: str = "dedup", seed: int = 0) -> Model:
+    """Built Wide&Deep :class:`Model` (params initialized)."""
+    model = Model(WideAndDeep(n_users=n_users, n_items=n_items,
+                              embedding_dim=embedding_dim,
+                              hidden=tuple(hidden), n_classes=n_classes,
+                              cross_buckets=cross_buckets, lookup=lookup))
+    probe = jnp.zeros((1,), jnp.int32)
+    model.build(seed, probe, probe)
+    return model
+
+
+def rating_batches(users: np.ndarray, items: np.ndarray, ratings: np.ndarray,
+                   batch_size: int):
+    """(user, item, rating 1..n_classes) triples → train batches.
+    Ratings arrive 1-based (the MovieLens convention the notebook uses);
+    targets are 0-based class indices for ``ClassNLLCriterion``."""
+    n = (len(users) // batch_size) * batch_size
+    out = []
+    for i in range(0, n, batch_size):
+        sl = slice(i, i + batch_size)
+        out.append({
+            "input": (np.asarray(users[sl], np.int32),
+                      np.asarray(items[sl], np.int32)),
+            "target": np.asarray(ratings[sl], np.int32) - 1,
+        })
+    return out
+
+
+def train_recommender(model: Model, batches, epochs: int = 5,
+                      lr: float = 1e-3, mesh=None,
+                      shard_tables: bool = True) -> Model:
+    """Train an NCF/Wide&Deep :class:`Model` on rating batches.  The
+    ``rec`` SpecSet is declared once: batches dim-0 over ``data``,
+    tables row-sharded over ``model`` when the mesh has that axis."""
+    specs = pipeline_specs("rec", mesh=mesh, shard_tables=shard_tables)
+    (Optimizer(model, batches, ClassNLLCriterion(), specs=specs)
+     .set_optim_method(Adam(lr))
+     .set_end_when(Trigger.max_epoch(epochs))
+     .optimize())
+    return model
+
+
+def predict_ratings(model: Model, users, items) -> np.ndarray:
+    """Predicted 1-based rating class per (user, item) pair."""
+    log_probs = np.asarray(model.forward(jnp.asarray(users, jnp.int32),
+                                         jnp.asarray(items, jnp.int32)))
+    return log_probs.argmax(axis=-1) + 1
+
+
+def rec_serving_tiers(model: Model, specs=None):
+    """Degradation-ladder rungs for the fleet runtime: recommendation
+    joins the multiplexed fleet (the 5th family after ssd/frcnn/ds2/
+    fraud) with a SPARSE-lookup workload.
+
+    Requests carry id pairs (``{"input": ((B,) int32 users, (B,) int32
+    items)}``).  Tier 0 serves full-precision tables through the
+    (optionally mesh-annotated) eval step — the dedup'd gather is the
+    device program; tier 1 serves weight-only int8: every table matches
+    the ``embedding$`` quantization pattern, so the int8 rung compresses
+    exactly the arrays that dominate the model.  Both rungs expose their
+    jitted program to the az-analyze serving audit (``rec/serve:*``)."""
+    from analytics_zoo_tpu.parallel import make_eval_step
+    from analytics_zoo_tpu.serving.ladder import ServingTier
+    from analytics_zoo_tpu.utils.quantize import (make_quantized_forward,
+                                                  quantize_params)
+
+    eval_step = make_eval_step(model.module, specs=specs)
+    qparams = quantize_params(model.variables)
+    qfwd = make_quantized_forward(model.module)
+
+    def _pair(batch: Dict):
+        users, items = batch["input"]
+        return jnp.asarray(users, jnp.int32), jnp.asarray(items, jnp.int32)
+
+    def fwd_fp(batch: Dict) -> np.ndarray:
+        return np.asarray(eval_step(model.variables, _pair(batch)))
+
+    def fwd_int8(batch: Dict) -> np.ndarray:
+        return np.asarray(qfwd(qparams, *_pair(batch)))
+
+    B = specs.data_axis_size if specs is not None else 1
+    ids = jax.ShapeDtypeStruct((B,), jnp.int32)
+
+    def audit_fp():
+        return (eval_step, (model.variables, (ids, ids)), ())
+
+    def audit_int8():
+        return (qfwd, (qparams, ids, ids), ())
+
+    return [
+        ServingTier("fp", fwd_fp, speed=1.0,
+                    quality_note="fp32 tables, dedup'd gather, annotated "
+                                 "eval step",
+                    device_program=audit_fp),
+        ServingTier("int8", fwd_int8, speed=0.8,
+                    quality_note="weight-only int8 lookup tables "
+                                 "(quantize_params embedding$ pattern)",
+                    device_program=audit_int8),
+    ]

@@ -1,0 +1,107 @@
+"""Sentiment-analysis pipeline: the GloVe-table family joins the zoo.
+
+Port of the reference's ``apps/sentimentAnalysis/sentiment.ipynb``:
+token ids → embedding table (trainable, or frozen GloVe vectors) →
+selectable GRU / LSTM / BiLSTM / CNN / CNN-LSTM head → binary sigmoid,
+trained with BCE.  The embedding table (vocab 20k × 100 for the
+notebook's GloVe geometry) dominates the parameter count, so the
+pipeline rides the same sharded-embedding substrate as recommendation:
+the model's lookup defaults to the dedup'd gather and
+``pipeline_specs("sentiment")`` row-shards the table over the ``model``
+mesh axis when one is declared.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.core.criterion import BCECriterion
+from analytics_zoo_tpu.core.module import Model
+from analytics_zoo_tpu.models import SentimentNet
+from analytics_zoo_tpu.parallel import Adam, Optimizer, Trigger, pipeline_specs
+
+
+def make_sentiment_model(vocab_size: int = 20000, embedding_dim: int = 100,
+                         hidden: int = 128, head: str = "gru",
+                         embeddings: Optional[np.ndarray] = None,
+                         lookup: str = "dedup", seq_len: int = 128,
+                         seed: int = 0) -> Model:
+    """Built SentimentNet :class:`Model` (params initialized at
+    ``seq_len`` — the heads are length-polymorphic, so serving may pick
+    a different bucket)."""
+    model = Model(SentimentNet(vocab_size=vocab_size,
+                               embedding_dim=embedding_dim, hidden=hidden,
+                               head=head, embeddings=embeddings,
+                               lookup=lookup))
+    model.build(seed, jnp.zeros((1, seq_len), jnp.int32))
+    return model
+
+
+def review_batches(tokens: np.ndarray, labels: np.ndarray, batch_size: int):
+    """(N, T) token ids + binary labels → train batches."""
+    n = (len(tokens) // batch_size) * batch_size
+    return [{"input": np.asarray(tokens[i:i + batch_size], np.int32),
+             "target": np.asarray(labels[i:i + batch_size], np.float32)}
+            for i in range(0, n, batch_size)]
+
+
+def train_sentiment(model: Model, batches, epochs: int = 5,
+                    lr: float = 1e-3, mesh=None,
+                    shard_tables: bool = True) -> Model:
+    """Train a SentimentNet on review batches with the declared
+    ``sentiment`` SpecSet (BCE head, per the notebook)."""
+    specs = pipeline_specs("sentiment", mesh=mesh,
+                           shard_tables=shard_tables)
+    (Optimizer(model, batches, BCECriterion(), specs=specs)
+     .set_optim_method(Adam(lr))
+     .set_end_when(Trigger.max_epoch(epochs))
+     .optimize())
+    return model
+
+
+def sentiment_serving_tiers(model: Model, specs=None, seq_len: int = 128):
+    """fp/int8 degradation rungs for the fleet runtime.  Requests carry
+    one token-id matrix (``{"input": (B, seq_len) int32}``); the GloVe
+    table matches the ``embedding$`` quantization pattern, so the int8
+    rung compresses the model's dominant array.  Both rungs expose their
+    jitted program to the az-analyze audit (``sentiment/serve:*``)."""
+    from analytics_zoo_tpu.parallel import make_eval_step
+    from analytics_zoo_tpu.serving.ladder import ServingTier
+    from analytics_zoo_tpu.utils.quantize import (make_quantized_forward,
+                                                  quantize_params)
+
+    eval_step = make_eval_step(model.module, specs=specs)
+    qparams = quantize_params(model.variables)
+    qfwd = make_quantized_forward(model.module)
+
+    def fwd_fp(batch: Dict) -> np.ndarray:
+        return np.asarray(eval_step(model.variables,
+                                    jnp.asarray(batch["input"], jnp.int32)))
+
+    def fwd_int8(batch: Dict) -> np.ndarray:
+        return np.asarray(qfwd(qparams,
+                               jnp.asarray(batch["input"], jnp.int32)))
+
+    B = specs.data_axis_size if specs is not None else 1
+    tokens = jax.ShapeDtypeStruct((B, seq_len), jnp.int32)
+
+    def audit_fp():
+        return (eval_step, (model.variables, tokens), ())
+
+    def audit_int8():
+        return (qfwd, (qparams, tokens), ())
+
+    return [
+        ServingTier("fp", fwd_fp, speed=1.0,
+                    quality_note="fp32 table + head, dedup'd gather, "
+                                 "annotated eval step",
+                    device_program=audit_fp),
+        ServingTier("int8", fwd_int8, speed=0.8,
+                    quality_note="weight-only int8 embedding table "
+                                 "(quantize_params)",
+                    device_program=audit_int8),
+    ]

@@ -515,8 +515,10 @@ class TestRepoClean:
 
     def test_az_analyze_all_clean_within_budget(self, capsys):
         """``tools/az_analyze.py --all`` in-process: exit 0, the full
-        audit surface covered, inside the ≤20 s tier-1 budget (measured
-        ~7 s on the 2-core CPU host)."""
+        audit surface covered, inside the ≤30 s tier-1 budget (the 20 s
+        pin covered the 25-program surface; ISSUE 17 grew it to 32 —
+        rec/sentiment train+eval+serve — so the budget scales with it;
+        measured ~9 s on the 2-core CPU host)."""
         import tools.az_analyze as az
         from analytics_zoo_tpu.analysis.targets import repo_audit_suite
 
@@ -525,16 +527,16 @@ class TestRepoClean:
         dt = time.time() - t0
         out = capsys.readouterr().out
         assert rc == 0, out
-        assert dt < 20.0, f"az-analyze --all took {dt:.1f}s (budget 20s)"
+        assert dt < 30.0, f"az-analyze --all took {dt:.1f}s (budget 30s)"
         assert "0 violation(s)" in out
         n = len(repo_audit_suite())
-        assert n >= 14  # 4 pipelines × train+eval, ≥3+3 serving tiers
+        assert n >= 21  # 6 pipelines × train+eval, ≥3+3+2+2 serving tiers
         assert f"{n} program(s) audited" in out
 
     def test_program_audit_surface_covers_acceptance_list(self):
-        """All four registered pipelines' train+eval programs plus the
-        SSD and DS2 serving tiers — the ISSUE-10 coverage line, pinned
-        against the live registry so a fifth pipeline must join the
+        """All six registered pipelines' train+eval programs plus every
+        family's serving tiers — the ISSUE-10 coverage line, pinned
+        against the live registry so a new pipeline must join the
         audit to register."""
         from analytics_zoo_tpu.analysis.targets import repo_audit_suite
         from analytics_zoo_tpu.parallel import registered_pipelines
@@ -563,6 +565,12 @@ class TestRepoClean:
         assert {"frcnn/serve:fp", "frcnn/serve:int8"} <= names
         assert {"fraud/serve:fp", "fraud/serve:int8"} <= names
         assert "ds2-stream/serve:stream" in names
+        # ISSUE 17: the sharded-embedding long tail — recommendation
+        # (both architectures: NCF train/eval + the Wide&Deep train
+        # program) and sentiment, serving rungs included
+        assert "rec-wd/train" in names
+        assert {"rec/serve:fp", "rec/serve:int8"} <= names
+        assert {"sentiment/serve:fp", "sentiment/serve:int8"} <= names
 
     def test_serving_tiers_expose_device_programs(self):
         """Every ladder rung the factories hand the runtime must carry
@@ -570,13 +578,15 @@ class TestRepoClean:
         silently."""
         from analytics_zoo_tpu.analysis.targets import (
             _ds2_serving, _ds2_streaming_serving, _fraud_serving,
-            _frcnn_serving, _ssd_serving)
+            _frcnn_serving, _rec_serving, _sentiment_serving,
+            _ssd_serving)
         from analytics_zoo_tpu.parallel import mesh as mesh_lib
 
         mesh = mesh_lib.create_mesh()
         for target in (_ssd_serving(mesh) + _ds2_serving(mesh)
                        + _ds2_streaming_serving(mesh)
-                       + _frcnn_serving(mesh) + _fraud_serving(mesh)):
+                       + _frcnn_serving(mesh) + _fraud_serving(mesh)
+                       + _rec_serving(mesh) + _sentiment_serving(mesh)):
             built = target.build()      # raises if the hook is missing
             assert callable(built.fn)
 

@@ -66,6 +66,21 @@ def _small_model_for(name: str) -> Model:
         model = Model(FraudMLP(in_features=29, hidden=10, n_classes=2))
         model.build(0, jnp.zeros((1, 29), jnp.float32))
         return model
+    if name == "rec":
+        from analytics_zoo_tpu.models import NeuralCF
+
+        model = Model(NeuralCF(n_users=16, n_items=12, n_classes=5,
+                               embedding_dim=8, mf_embedding_dim=4,
+                               hidden=(16, 8)))
+        model.build(0, jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32))
+        return model
+    if name == "sentiment":
+        from analytics_zoo_tpu.models import SentimentNet
+
+        model = Model(SentimentNet(vocab_size=64, embedding_dim=8,
+                                   hidden=8, head="gru"))
+        model.build(0, jnp.zeros((1, 12), jnp.int32))
+        return model
     raise AssertionError(
         f"pipeline {name!r} registered in parallel.specs but this test "
         f"has no model factory for it — add one so the structure-match "
@@ -79,6 +94,8 @@ _VARIANTS = {
     "frcnn": [{}],
     "ds2": [{}],
     "fraud": [{}],
+    "rec": [{}, {"shard_tables": False}],
+    "sentiment": [{}, {"shard_tables": False}],
 }
 
 

@@ -330,6 +330,11 @@ class TestServeFleetDrill:
         assert out["autoscaled"]["autoscale"]["grows"] >= 1
         assert out["prewarm_subphase"]["on"]["pool"]["cold_compiles"] == 0
         assert out["prewarm_subphase"]["off"]["pool"]["cold_compiles"] > 0
+        # ISSUE 17: the recommendation family (DedupEmbed lookup tower)
+        # multiplexes in the smoke fleet and actually serves traffic
+        assert "rec" in out["config"]["model_mix"]
+        rec = out["static_pool"]["per_model"]["rec"]
+        assert rec["completed"] > 0
 
     def test_committed_fleet_artifact_banks_the_scale_claims(self):
         """The committed full-scale artifact's own claims (strict —
@@ -561,6 +566,62 @@ class TestCheckArtifacts:
                 in doc["headline"]
             assert f"pallas_over_blocked_ratio_h{h}_fwd" \
                 in doc["headline"]
+
+    def test_issue17_bench_r11_is_stamped_not_grandfathered(self):
+        """ISSUE 17 satellite: the BENCH_r11 banking is covered by the
+        lint as a STAMPED artifact — the LEGACY set stayed closed."""
+        import json
+
+        from tools.check_artifacts import LEGACY, PATTERN, REQUIRED_KEYS
+
+        root = os.path.join(os.path.dirname(__file__), os.pardir)
+        name = "BENCH_r11.json"
+        assert PATTERN.match(name)
+        assert name not in LEGACY, f"{name} must not be grandfathered"
+        doc = json.load(open(os.path.join(root, name)))
+        meta = doc["run_metadata"]
+        assert all(k in meta for k in REQUIRED_KEYS)
+
+    def test_committed_bench_r11_banks_the_rec_ab(self):
+        """The r11 artifact's own claims hold: every line carries the
+        SAME seeded Zipfian geometry (vocab/dim/batch/seed and the
+        batch's unique_fraction — the equal-geometry contract), every
+        ratio line keeps per-window values, the sweep's widest line has
+        the table GENUINELY row-sharded, virtual labeling is honest
+        (CPU backend ⇒ virtual), and the headline ratios are present —
+        with dedup beating the densifying one-hot reference."""
+        import json
+
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "BENCH_r11.json")
+        doc = json.load(open(path))
+        assert doc["round"] == 11 and doc["phase"] == "rec_embedding"
+        lines = doc["lines"]
+        assert len(lines) >= 6
+        geo = {(ln["vocab"], ln["dim"], ln["batch"], ln["seed"],
+                ln["unique_fraction"]) for ln in lines}
+        assert len(geo) == 1, f"geometry drifted across lines: {geo}"
+        assert next(iter(geo))[3] == 0                  # seed
+        for ln in lines:
+            assert len(ln["windows"]) >= 2, ln["metric"]
+            assert ln["virtual"] == (doc["backend"] != "tpu")
+            if ln["vs_baseline"] is not None:
+                assert len(ln["ratio_windows"]) == len(ln["windows"])
+                assert ln["anchor"]
+        widest = max((ln for ln in lines if "sharded_w" in ln["metric"]),
+                     key=lambda ln: ln["width"])
+        if widest["width"] > 1:
+            assert widest["table_row_sharded"] is True
+        sparse = next(ln for ln in lines
+                      if "sparse_over_dense" in ln["metric"])
+        assert sparse["rows_touched"] < sparse["vocab"]
+        head = doc["headline"]
+        for key in ("dedup_over_onehot_ratio", "dedup_over_naive_ratio",
+                    "sparse_over_dense_apply_ratio", "unique_fraction"):
+            assert key in head
+        # the transferable claim: never materializing the (batch, vocab)
+        # one-hot / densified cotangent wins on every backend
+        assert head["dedup_over_onehot_ratio"] > 1.0
 
     def test_committed_bench_r09_banks_the_fused_ab(self):
         """The r09 artifact's own claims hold: both readings carry

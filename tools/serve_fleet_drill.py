@@ -61,13 +61,22 @@ BURST_X = 2.5                   # extra multiplier inside the burst window
 BURST_WINDOW = (0.55, 0.65)     # fraction of the day
 MODEL_MIX = (("ssd", 0.30), ("frcnn", 0.15), ("ds2", 0.25),
              ("fraud", 0.30))
-DEADLINES = {"ssd": 0.25, "frcnn": 0.40, "ds2": 0.35, "fraud": 0.08}
+#: the smoke mix adds the ISSUE-17 recommendation family (a DedupEmbed
+#: lookup tower — the zoo's long tail joins the multiplexed fleet); the
+#: FULL drill keeps MODEL_MIX so the script stays coherent with the
+#: banked SERVING_SCALE_r01.json until the next full re-bank.
+SMOKE_MODEL_MIX = (("ssd", 0.276), ("frcnn", 0.138), ("ds2", 0.23),
+                   ("fraud", 0.276), ("rec", 0.08))
+DEADLINES = {"ssd": 0.25, "frcnn": 0.40, "ds2": 0.35, "fraud": 0.08,
+             "rec": 0.06}
 DS2_EDGES = (32, 64, 96)
 
 #: virtual service seconds per max_batch=8 batch at tier 0
-SERVICE = {"ssd": 0.050, "frcnn": 0.080, "ds2": 0.040, "fraud": 0.008}
+SERVICE = {"ssd": 0.050, "frcnn": 0.080, "ds2": 0.040, "fraud": 0.008,
+           "rec": 0.006}
 TIER_SPEEDS = {"ssd": (1.0, 0.75), "frcnn": (1.0, 0.77),
-               "ds2": (1.0, 0.8), "fraud": (1.0, 0.8)}
+               "ds2": (1.0, 0.8), "fraud": (1.0, 0.8),
+               "rec": (1.0, 0.8)}
 
 MAX_BATCH = 8
 QUEUE_CAPACITY = 384
@@ -112,7 +121,8 @@ def intensity_profile(day_s: float, burst: bool, k: int = 2048):
     return t, cum / cum[-1]
 
 
-def build_trace(seed: int, n: int, day_s: float, burst: bool = True):
+def build_trace(seed: int, n: int, day_s: float, burst: bool = True,
+                mix=MODEL_MIX):
     """The seeded arrival script as flat arrays: sorted arrival times
     inverse-CDF sampled against the diurnal(+burst) intensity, the
     per-request model, and the ds2 rows' variable lengths."""
@@ -120,8 +130,8 @@ def build_trace(seed: int, n: int, day_s: float, burst: bool = True):
     grid_t, cdf = intensity_profile(day_s, burst)
     u = np.sort(rng.random(n))
     t_arr = np.interp(u, cdf, grid_t)
-    names = [m for m, _ in MODEL_MIX]
-    probs = np.asarray([p for _, p in MODEL_MIX])
+    names = [m for m, _ in mix]
+    probs = np.asarray([p for _, p in mix])
     model_idx = rng.choice(len(names), size=n, p=probs).astype(np.int8)
     lengths = rng.integers(18, DS2_EDGES[-1] + 1,
                            size=n).astype(np.int16)
@@ -141,31 +151,47 @@ def trace_digest(trace) -> str:
 # ---------------------------------------------------------------------------
 
 
-def build_model_set(seed: int):
-    """Four tiny-but-real model families, each with an fp + weight-only
+#: per-request id positions of the "rec" family's lookup payload
+REC_IDS = 12
+REC_VOCAB, REC_DIM = 64, 8
+
+
+def build_model_set(seed: int, mix=MODEL_MIX):
+    """Tiny-but-real model families, each with an fp + weight-only
     int8 tier (the quantize_params mechanism, like every production
     ladder in the repo) and ``device_program`` audit hooks.  Shared
     across arms — the tier forwards are stateless, so both arms (and
-    the replay runs) dispatch the SAME compiled programs."""
+    the replay runs) dispatch the SAME compiled programs.  The "rec"
+    family (smoke mix) is a DedupEmbed lookup tower — the ISSUE-17
+    dedup'd gather inside a genuine jitted serving program."""
     import flax.linen as nn
     import jax
     import jax.numpy as jnp
 
     from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.ops.embedding import DedupEmbed
     from analytics_zoo_tpu.parallel import make_eval_step
     from analytics_zoo_tpu.serving import ModelConfig, ServingTier
     from analytics_zoo_tpu.obs.slo import model_slos
     from analytics_zoo_tpu.utils.quantize import (make_quantized_forward,
                                                   quantize_params)
 
-    dims = {"ssd": 64, "frcnn": 96, "ds2": 8, "fraud": 29}
+    class RecTower(nn.Module):
+        @nn.compact
+        def __call__(self, ids):
+            emb = DedupEmbed(REC_VOCAB, REC_DIM, name="embed")(ids)
+            return nn.Dense(4)(emb.mean(axis=1))
+
+    dims = {"ssd": 64, "frcnn": 96, "ds2": 8, "fraud": 29,
+            "rec": REC_IDS}
     configs = []
-    for i, (name, _) in enumerate(MODEL_MIX):
-        module = nn.Dense(4)
+    for i, (name, _) in enumerate(mix):
+        module = RecTower() if name == "rec" else nn.Dense(4)
         model = Model(module)
         in_dim = dims[name]
         example = (jnp.zeros((1, DS2_EDGES[0], in_dim), jnp.float32)
                    if name == "ds2"
+                   else jnp.zeros((1, in_dim), jnp.int32) if name == "rec"
                    else jnp.zeros((1, in_dim), jnp.float32))
         model.build(seed + i, example)
         eval_step = make_eval_step(module)
@@ -182,8 +208,9 @@ def build_model_set(seed: int):
         def audit_fp(_ev=eval_step, _m=model, _d=in_dim, _name=name):
             shape = ((1, DS2_EDGES[0], _d) if _name == "ds2"
                      else (1, _d))
+            dt = jnp.int32 if _name == "rec" else jnp.float32
             return (_ev, (_m.variables,
-                          jax.ShapeDtypeStruct(shape, jnp.float32)), ())
+                          jax.ShapeDtypeStruct(shape, dt)), ())
 
         tiers = [
             ServingTier("fp", fwd_fp, speed=TIER_SPEEDS[name][0],
@@ -207,6 +234,10 @@ def build_payloads():
     dims = {"ssd": 64, "frcnn": 96, "fraud": 29}
     payloads = {name: {"input": np.ones((d,), np.float32)}
                 for name, d in dims.items()}
+    # Zipf-flavored repeated ids — the rec tower's dedup'd lookup sees
+    # the duplicate-heavy traffic it exists for
+    payloads["rec"] = {"input": np.asarray(
+        [1, 1, 1, 5, 5, 9, 1, 5, 23, 1, 9, 41][:REC_IDS], np.int32)}
     ds2 = {int(n): {"input": np.ones((int(n), 8), np.float32)}
            for n in range(18, DS2_EDGES[-1] + 1)}
     return payloads, ds2
@@ -366,8 +397,9 @@ def fleet_drill(seed: int, smoke: bool = False,
     scale = (100 if smoke else 1) * scale
     n = N_REQUESTS // scale
     day_s = n / MEAN_RATE
-    configs = build_model_set(seed)
-    trace = build_trace(seed, n, day_s, burst=True)
+    mix = SMOKE_MODEL_MIX if smoke else MODEL_MIX
+    configs = build_model_set(seed, mix=mix)
+    trace = build_trace(seed, n, day_s, burst=True, mix=mix)
 
     static, static_replay = run_twice(
         trace, configs, autoscale=False, n_replicas=STATIC_REPLICAS)
@@ -380,7 +412,7 @@ def fleet_drill(seed: int, smoke: bool = False,
     # windows + policy loop to actually trip inside the run.
     sub_n = n // 8 if not smoke else max(n // 2, 4000)
     sub_trace = build_trace(seed + 1, sub_n, sub_n / MEAN_RATE,
-                            burst=True)
+                            burst=True, mix=mix)
     warm, warm_replay = run_twice(
         sub_trace, configs, autoscale=True, prewarm=True,
         n_replicas=AUTOSCALE["min_replicas"])
@@ -428,7 +460,7 @@ def fleet_drill(seed: int, smoke: bool = False,
             "n_requests": n, "day_s": round(day_s, 3),
             "mean_rate_rps": MEAN_RATE, "diurnal_amp": DIURNAL_AMP,
             "burst_x": BURST_X, "burst_window_frac": list(BURST_WINDOW),
-            "model_mix": {m: p for m, p in MODEL_MIX},
+            "model_mix": {m: p for m, p in mix},
             "deadlines_s": DEADLINES,
             "service_s_per_batch_tier0": SERVICE,
             "tier_speeds": {m: list(v) for m, v in TIER_SPEEDS.items()},
