@@ -592,6 +592,35 @@ def _sentiment_serving(mesh) -> List[AuditProgram]:
     return _tier_targets("sentiment", tiers, specs)
 
 
+def _fraud_swapped_serving(mesh) -> List[AuditProgram]:
+    """ISSUE 18 (live weights): ``ServingRuntime.hot_swap`` rebuilds a
+    family's tier stack from a RESTORED checkpoint pytree — plain
+    nested dicts of host arrays (what ``checkpoint.load`` returns, not
+    the boot-time FrozenDict) pushed through the declared SpecSet's
+    ``place_state``.  The programs a swapped-in replica dispatches must
+    stay under the audit exactly like the boot-time stack, so this
+    target builds the fraud tiers through that restore → place →
+    rebuild path."""
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.models import FraudMLP
+    from analytics_zoo_tpu.parallel import pipeline_specs
+    from analytics_zoo_tpu.pipelines.fraud import fraud_serving_tiers
+
+    def plain(tree):
+        if hasattr(tree, "items"):
+            return {k: plain(v) for k, v in tree.items()}
+        return np.asarray(tree)
+
+    module = FraudMLP(in_features=29, hidden=10, n_classes=2)
+    model = Model(module)
+    restored = plain(filled(abstract_variables(
+        module, _S((1, 29), np.float32))))
+    specs = pipeline_specs("fraud", mesh=mesh)
+    model.variables = specs.place_state(restored)
+    tiers = fraud_serving_tiers(model, specs=specs)
+    return _tier_targets("fraud-swapped", tiers, specs)
+
+
 def _guarded_tiers(kind: str, builder, mesh) -> List[AuditProgram]:
     """The serving-tier targets need the tier FACTORIES to run before
     the target names are even known (names come from the rungs).  A
@@ -632,6 +661,10 @@ def repo_audit_suite(mesh=None) -> List[AuditProgram]:
     targets += _guarded_tiers("ds2-stream", _ds2_streaming_serving, mesh)
     targets += _guarded_tiers("frcnn", _frcnn_serving, mesh)
     targets += _guarded_tiers("fraud", _fraud_serving, mesh)
+    # ISSUE 18: the hot-swapped tier stack (checkpoint-restored
+    # variables → place_state → tiers) audits like the boot-time one
+    targets += _guarded_tiers("fraud-swapped", _fraud_swapped_serving,
+                              mesh)
     targets += _guarded_tiers("rec", _rec_serving, mesh)
     targets += _guarded_tiers("sentiment", _sentiment_serving, mesh)
     return targets

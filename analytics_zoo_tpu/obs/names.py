@@ -78,6 +78,31 @@ CATALOG: Dict[str, str] = {
     "serve/cold_compiles":
         "counter · dispatches that paid the cold-compile tax (a replica "
         "served a geometry it had never compiled — what pre-warm deletes)",
+    # -- live-weight hot-swap + canary (ServingRuntime.hot_swap) ------------
+    "serve/swap/rollouts":
+        "counter · hot-swap rollouts started (checkpoint verified, "
+        "canary stage armed)",
+    "serve/swap/replicas_swapped":
+        "counter · replicas drained, re-installed with new weights and "
+        "rejoined during rollouts",
+    "serve/swap/rollbacks":
+        "counter · rollouts reverted to the serve-lkg checkpoint tier "
+        "(tripped canary or mid-rollout anomaly; exactly once each)",
+    "serve/swap/lkg_promotions":
+        "counter · serving last-known-good promotions after fully "
+        "healthy rollouts (the hysteresis mirror of train LKG)",
+    "serve/canary/mirrored/model=*":
+        "counter · live requests mirrored to the canary weights per "
+        "model (seeded fraction; never counted in accounting())",
+    "serve/canary/divergence/model=*":
+        "histogram · per-row output divergence between live and canary "
+        "weights, labeled model= and swap= (rollout index)",
+    "serve/canary/latency_s/model=*":
+        "histogram · modeled service latency of the canary tier, "
+        "labeled model= and swap= (rollout index)",
+    "serve/canary/trips":
+        "counter · canary stages tripped over their divergence/latency "
+        "budgets (each one triggers a rollback)",
     # -- autoscaler (serving.autoscale.Autoscaler) --------------------------
     "autoscale/replicas":
         "gauge · current (or just-actuated target) replica-pool size",

@@ -181,6 +181,45 @@ def model_slos(model: str, miss_budget: float = 0.2,
             model_shed_rate_slo(model, shed_budget)]
 
 
+def canary_divergence_slo(model: str, budget: float,
+                          rollout: int = 0) -> SLO:
+    """Canary output divergence ≤ ``budget`` — the worst per-row
+    divergence between the live tier and the mirrored new-weights tier
+    (``:max`` off the rollout-labeled reservoir: ONE poisoned row must
+    trip, a percentile could hide it).  The name is rollout-scoped so a
+    previous rollout's divergence history can never trip — or mask — the
+    next canary."""
+    return SLO(
+        name=f"canary-divergence/model={model}", kind="threshold",
+        budget=budget,
+        value=f"serve/canary/divergence/model={model}/swap={rollout}:max",
+        description=f"worst mirrored-output divergence of the {model} "
+                    f"canary <= {budget}")
+
+
+def canary_latency_slo(model: str, budget_s: float,
+                       rollout: int = 0) -> SLO:
+    """Canary modeled service latency p99 ≤ ``budget_s`` — catches a new
+    checkpoint whose tiers got slower even when outputs match."""
+    return SLO(
+        name=f"canary-latency/model={model}", kind="threshold",
+        budget=budget_s,
+        value=f"serve/canary/latency_s/model={model}/swap={rollout}:p99",
+        description=f"p99 modeled canary service latency of {model} "
+                    f"<= {budget_s}s")
+
+
+def canary_slos(model: str, divergence_budget: float,
+                latency_budget_s: Optional[float] = None,
+                rollout: int = 0) -> List[SLO]:
+    """The objectives one hot-swap canary stage evaluates (a fresh
+    evaluator per rollout, over rollout-labeled metric names)."""
+    out = [canary_divergence_slo(model, divergence_budget, rollout)]
+    if latency_budget_s is not None:
+        out.append(canary_latency_slo(model, latency_budget_s, rollout))
+    return out
+
+
 def _match_sum(counters: Dict[str, Any],
                patterns: Sequence[str]) -> float:
     total = 0.0

@@ -47,14 +47,19 @@ class Span:
     def ended(self) -> bool:
         return self.t_end is not None
 
-    def end(self, status: str = "ok", **attrs: Any) -> None:
+    def end(self, status: str = "ok", at: Optional[float] = None,
+            **attrs: Any) -> None:
         """Close the span and emit it to the recorder.  A second call is
         a no-op (the serving shed paths can race a drain force-flush for
-        who closes a request; first writer wins)."""
+        who closes a request; first writer wins).  ``at`` stamps an
+        explicit end instant instead of the clock read — the parallel
+        service model computes each batch's completion on its replica's
+        busy horizon, a future instant the clock has not reached when the
+        dispatch bookkeeping runs."""
         if self.ended:
             return
         self.attrs.update(attrs)
-        self.t_end = self.tracer.now()
+        self.t_end = self.tracer.now() if at is None else float(at)
         self.status = status
         self.tracer._emit(self)
 

@@ -147,11 +147,14 @@ def _state_step(host_state: Any) -> Optional[int]:
 #: Snapshot tiers with their own named slot beside ``latest``/``step_N``.
 #: ``lkg`` (last-known-good) is written by the anomaly sentinel only
 #: after the health word has been clean for ``promote_after`` steps —
-#: the rollback target of the numerical-anomaly ladder.  Tier slots are
-#: deliberately NOT restore candidates for the normal resume path
-#: (``_candidates``): an LKG snapshot is typically OLDER than ``latest``
-#: and must never silently rewind an ordinary restart.
-TIERS = ("lkg",)
+#: the rollback target of the numerical-anomaly ladder.  ``serve-lkg``
+#: is its serving twin: promoted by the runtime's hot-swap machinery
+#: only after a rollout has served clean decision windows, and the
+#: rollback target of a tripped canary.  Tier slots are deliberately
+#: NOT restore candidates for the normal resume path (``_candidates``):
+#: a tier snapshot is typically OLDER than ``latest`` and must never
+#: silently rewind an ordinary restart.
+TIERS = ("lkg", "serve-lkg")
 
 
 def save(path: str, state: Any, step: Optional[int] = None,
@@ -328,19 +331,109 @@ def newest_intact(path: str) -> Optional[Tuple[str, Dict[str, Any]]]:
     return None
 
 
-def lkg_snapshot(path: str) -> Optional[Tuple[str, Dict[str, Any]]]:
-    """``(snapshot_dir, manifest)`` of the last-known-good tier slot when
-    it exists AND verifies, else ``None``.  The LKG tier is tracked
-    separately from ``latest``/``step_N`` (it is not a normal resume
-    candidate); this is the anomaly ladder's rollback target."""
-    snap = os.path.join(os.path.abspath(path), "lkg")
+def tier_snapshot(path: str, tier: str) -> Optional[Tuple[str, Dict[str, Any]]]:
+    """``(snapshot_dir, manifest)`` of a named tier slot when it exists
+    AND verifies, else ``None``.  Tier slots are tracked separately from
+    ``latest``/``step_N`` (never a normal resume candidate); they are the
+    rollback targets of the anomaly ladder (``lkg``) and of the serving
+    hot-swap canary (``serve-lkg``)."""
+    if tier not in TIERS:
+        raise ValueError(f"unknown checkpoint tier {tier!r}; one of {TIERS}")
+    snap = os.path.join(os.path.abspath(path), tier)
     if not os.path.isdir(snap):
         return None
     try:
         return snap, verify_snapshot(snap)
     except CheckpointCorrupt as e:
-        logger.warning("checkpoint: last-known-good slot unusable (%s)", e)
+        logger.warning("checkpoint: %s tier slot unusable (%s)", tier, e)
         return None
+
+
+def lkg_snapshot(path: str) -> Optional[Tuple[str, Dict[str, Any]]]:
+    """``(snapshot_dir, manifest)`` of the last-known-good tier slot when
+    it exists AND verifies, else ``None``.  The LKG tier is tracked
+    separately from ``latest``/``step_N`` (it is not a normal resume
+    candidate); this is the anomaly ladder's rollback target."""
+    return tier_snapshot(path, "lkg")
+
+
+def promote_tier(path: str, snap_dir: str, tier: str) -> str:
+    """Copy an already-published (and verifying) snapshot into a named
+    tier slot with the same atomic temp-write → manifest → rename
+    lifecycle as :func:`save`.  Unlike ``save(tier=...)`` this never
+    re-serializes the pytree — it promotes the exact bytes that served
+    (or trained) clean, which is the point of a last-known-good slot.
+
+    The promoted copy's manifest records the source slot under
+    ``meta.promoted_from``.  Returns the tier slot path."""
+    if tier not in TIERS:
+        raise ValueError(f"unknown checkpoint tier {tier!r}; one of {TIERS}")
+    src = os.path.abspath(snap_dir)
+    man = verify_snapshot(src)  # never promote bytes we can't vouch for
+    base = os.path.abspath(path)
+    target = os.path.join(base, tier)
+    if src == target:
+        return target  # already the tier slot
+    tmp = os.path.join(base, f".tmp_{tier}")
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    _fire("pre_save", target)
+    shutil.copytree(src, tmp)
+    meta = dict(man.get("meta", {}))
+    meta.update({"name": tier, "tier": tier,
+                 "promoted_from": os.path.basename(src)})
+    manifest = _build_manifest(tmp, meta)
+    with open(os.path.join(tmp, MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    _fire("pre_publish", target)
+    trash = os.path.join(base, f".trash_{tier}")
+    if os.path.exists(target):
+        if os.path.isdir(trash):
+            shutil.rmtree(trash)
+        os.rename(target, trash)
+    os.rename(tmp, target)
+    shutil.rmtree(trash, ignore_errors=True)
+    _fire("post_publish", target)
+    return target
+
+
+class CheckpointWatcher:
+    """Poll-based "new checkpoint published" watch over a checkpoint
+    directory — the serving side's view of a trainer that keeps
+    publishing ``latest``/``step_N`` snapshots into shared storage.
+
+    Construction baselines the current newest intact snapshot; each
+    :meth:`poll` answers "has a DIFFERENT intact snapshot been published
+    since the last poll?" by fingerprinting the manifest's per-file
+    sha256 map (content identity, not mtime — atomic renames and GC make
+    timestamps meaningless here).  Tier slots (``lkg``/``serve-lkg``)
+    are never restore candidates, so a promotion or rollback does not
+    retrigger the watcher."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        self._seen = self._fingerprint()[0]
+
+    def _fingerprint(self) -> Tuple[Optional[str],
+                                    Optional[Tuple[str, Dict[str, Any]]]]:
+        found = newest_intact(self.path)
+        if found is None:
+            return None, None
+        _snap, man = found
+        digest = hashlib.sha256(json.dumps(
+            {rel: info["sha256"] for rel, info in man.get("files", {}).items()},
+            sort_keys=True).encode()).hexdigest()
+        return digest, found
+
+    def poll(self) -> Optional[Tuple[str, Dict[str, Any]]]:
+        """``(snapshot_dir, manifest)`` of a newly-published intact
+        snapshot, or ``None`` when nothing changed since the last poll
+        (or construction).  Marks the returned snapshot as seen."""
+        digest, found = self._fingerprint()
+        if digest is None or digest == self._seen:
+            return None
+        self._seen = digest
+        return found
 
 
 def _restore(snap_dir: str, target: Any, verify: bool) -> Any:

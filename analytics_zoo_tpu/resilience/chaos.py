@@ -215,6 +215,15 @@ class ChaosMonkey:
         self._fired = [False] * len(self.faults)
         self._armed_hook = None    # mid_save_kill hook awaiting a save
 
+    def arm(self, fault: FaultSpec) -> None:
+        """Schedule an additional fault mid-run — how a drill targets a
+        fault at a condition only known at runtime (e.g. "crash a
+        replica while THIS rollout is draining"): observe the state,
+        then arm a spec at a near-future index.  Deterministic as long
+        as the observed state and the chosen index are."""
+        self.faults.append(fault)
+        self._fired.append(False)
+
     # -- dataset hook ------------------------------------------------------
     def dataset(self, ds) -> "ChaosDataset":
         """Wrap ``ds`` so faults fire at their scheduled batch indices.

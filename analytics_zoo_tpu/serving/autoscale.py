@@ -98,6 +98,12 @@ class Autoscaler:
         self.decisions = 0
         self.grows = 0
         self.shrinks = 0
+        self.holds = 0
+        #: actuation freeze (the hot-swap canary stage sets this): the
+        #: loop keeps observing — streaks and cooldown advance normally —
+        #: but no target is returned while held.  A canary burn must
+        #: trip the ROLLBACK, not mask itself behind fresh capacity.
+        self.hold = False
         self.events: List[Dict[str, Any]] = []
 
     # -- feed ----------------------------------------------------------------
@@ -164,13 +170,28 @@ class Autoscaler:
                 and current_size < p.max_replicas:
             target = min(current_size + p.step, p.max_replicas)
             action = "grow"
-            self.grows += 1
         elif self.shrink_streak >= p.shrink_after \
                 and current_size > p.min_replicas:
             target = max(current_size - p.step, p.min_replicas)
             action = "shrink"
-            self.shrinks += 1
+        if target is not None and self.hold:
+            # held (mid-canary): swallow the actuation, keep the streak
+            # reset + cooldown so release doesn't fire a stale decision
+            self.holds += 1
+            self.events.append({
+                "kind": "scale_held", "t": round(t, 6),
+                "from": current_size, "would": target,
+                "action": action, "burning": list(burning or [])})
+            self.grow_streak = 0
+            self.shrink_streak = 0
+            self.cooldown_left = p.cooldown
+            self._export(current_size)
+            return None
         if target is not None:
+            if action == "grow":
+                self.grows += 1
+            else:
+                self.shrinks += 1
             self.grow_streak = 0
             self.shrink_streak = 0
             self.cooldown_left = p.cooldown
@@ -198,5 +219,6 @@ class Autoscaler:
             "decisions": self.decisions,
             "grows": self.grows,
             "shrinks": self.shrinks,
+            "holds": self.holds,
             "actions": list(self.events),
         }

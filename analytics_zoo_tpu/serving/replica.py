@@ -113,6 +113,10 @@ class Replica:
         self.compile_s = float(compile_s)
         self.warm_keys = warm_keys
         self.state = "healthy"       # healthy|fenced|warming|draining
+        #: draining for a live-weight swap, NOT for retirement — the
+        #: rollout machine re-admits this replica after installing the
+        #: new weights instead of ``_revive`` removing it
+        self.swap_drain = False
         self.restart_at: Optional[float] = None
         self.warm_ready_at: Optional[float] = None
         self._warm_plan: Sequence[GeometryKey] = ()
@@ -311,6 +315,15 @@ class ReplicaPool:
         self.compile_s = float(compile_s)
         self._rr = 0
         self._rid_counter = max(r.rid for r in self.replicas) + 1
+        #: active hot-swap rollout (None between rollouts) — see hot_swap
+        self._swap: Optional[Dict[str, Any]] = None
+        #: rids the rollout must NOT drain yet (the runtime refreshes
+        #: this with the session-pinned set every pump: session-affine
+        #: replicas are swapped LAST, after their sessions close)
+        self.swap_defer: Set[int] = set()
+        self.swaps_completed = 0
+        self.swaps_started = 0
+        self.last_rollout: Optional[Dict[str, Any]] = None
         for r in self.replicas:
             self._adopt(r)
 
@@ -336,13 +349,14 @@ class ReplicaPool:
                 self._event({"kind": "replica_prewarmed",
                              "replica": r.rid, "t": round(now, 6),
                              "geometries": len(r.warm_keys or ())})
-            elif r.state == "draining" and r.inflight == 0 \
-                    and r.busy_until <= now:
+            elif r.state == "draining" and not r.swap_drain \
+                    and r.inflight == 0 and r.busy_until <= now:
                 retired.append(r)
         for r in retired:
             self.replicas.remove(r)
             self._event({"kind": "replica_retired", "replica": r.rid,
                          "t": round(now, 6)})
+        self._step_rollout(now)
 
     def healthy(self) -> List[Replica]:
         self._revive()
@@ -456,6 +470,16 @@ class ReplicaPool:
             elif modeled:
                 r.warm_keys = set()     # joins cold: pays per-dispatch tax
             self.replicas.append(r)
+            if self._swap is not None:
+                # growth mid-rollout joins with the NEW weights already
+                # installed — it must not serve the retiring checkpoint,
+                # and the rollout must not re-drain it
+                self._swap["install"](r)
+                self._swap["swapped"].append(rid)
+                self._event({"kind": "swap_installed", "replica": rid,
+                             "t": round(now, 6),
+                             "checkpoint": self._swap["checkpoint"],
+                             "grown": True})
             self._event({"kind": "replica_joined", "replica": rid,
                          "t": round(now, 6), "prewarm": bool(prewarm),
                          "state": r.state})
@@ -484,12 +508,173 @@ class ReplicaPool:
         self._revive()                  # idle victims retire immediately
         return actions
 
+    # -- live-weight hot-swap (the rollout state machine) ---------------------
+    @property
+    def rollout_active(self) -> bool:
+        return self._swap is not None
+
+    def hot_swap(self, checkpoint: str,
+                 install: Callable[[Replica], None],
+                 warm_s: Optional[float] = None,
+                 last: Sequence[int] = ()) -> Dict[str, Any]:
+        """Start a zero-downtime weight rollout: one replica at a time is
+        drained (state ``draining`` with the ``swap_drain`` mark — never
+        retired), ``install(replica)`` swaps its weights once idle, the
+        replica re-warms its compiled geometries (when compile modeling
+        is armed) and rejoins dispatch before the next victim drains.
+        The rollout advances from :meth:`_revive`, i.e. on every ordinary
+        dispatch cycle — no extra driver needed.
+
+        ``checkpoint`` is the snapshot directory the new weights came
+        from; its sha256 manifest is verified HERE too (defense in depth
+        — the runtime already verified at load), so a truncated publish
+        can never start draining replicas.  ``last`` rids are queued at
+        the tail (session-pinned replicas swap last); rids in
+        ``swap_defer`` are additionally held until the runtime clears
+        them.  In-flight batches on the draining replica finish or ride
+        the ordinary exactly-once failover latch — ``accounting()``
+        conserves every request across the rollout."""
+        if self._swap is not None:
+            raise RuntimeError(
+                f"hot_swap: rollout of {self._swap['checkpoint']!r} "
+                f"still in progress")
+        from analytics_zoo_tpu.parallel import checkpoint as ckpt
+
+        ckpt.verify_snapshot(checkpoint)
+        last_set = set(last)
+        order = sorted(r.rid for r in self.replicas
+                       if r.state != "draining" and r.rid not in last_set)
+        order += sorted(r.rid for r in self.replicas
+                        if r.state != "draining" and r.rid in last_set)
+        self._swap = {"checkpoint": checkpoint, "install": install,
+                      "warm_s": warm_s, "pending": order,
+                      "current": None, "phase": None, "swapped": []}
+        self.swaps_started += 1
+        self._event({"kind": "swap_rollout_started",
+                     "checkpoint": checkpoint, "order": list(order),
+                     "t": round(self.clock.now(), 6)})
+        self._step_rollout(self.clock.now())
+        return dict(self._swap, install=None)
+
+    def _step_rollout(self, now: float) -> None:
+        """Advance the active rollout one step.  Idempotent; called from
+        ``_revive`` so the machine moves whenever pool state is read."""
+        sw = self._swap
+        if sw is None:
+            return
+        cur = self.replica_by_rid(sw["current"]) \
+            if sw["current"] is not None else None
+        if sw["current"] is not None and cur is None:
+            sw["current"] = None     # victim retired mid-drain (resize)
+        if cur is not None:
+            if sw["phase"] == "drain":
+                if cur.state == "healthy":
+                    # fenced mid-drain and restarted: resume the drain
+                    cur.state = "draining"
+                if cur.state == "draining" and cur.inflight == 0 \
+                        and cur.busy_until <= now:
+                    sw["install"](cur)
+                    cur.swap_drain = False
+                    sw["swapped"].append(cur.rid)
+                    self._event({"kind": "swap_installed",
+                                 "replica": cur.rid, "t": round(now, 6),
+                                 "checkpoint": sw["checkpoint"]})
+                    if self.compile_s > 0 and self.prewarm_keys:
+                        warm = sw["warm_s"] if sw["warm_s"] is not None \
+                            else self.compile_s * len(self.prewarm_keys)
+                        cur.begin_warming(self.prewarm_keys, now + warm)
+                        sw["phase"] = "warm"
+                    else:
+                        cur.state = "healthy"
+                        cur.watchdog.reset()
+                        self._event({"kind": "swap_rejoined",
+                                     "replica": cur.rid,
+                                     "t": round(now, 6)})
+                        sw["current"] = None
+                return  # one replica at a time: wait for drain/warm
+            if sw["phase"] == "warm":
+                if cur.state == "warming":
+                    return
+                self._event({"kind": "swap_rejoined", "replica": cur.rid,
+                             "t": round(now, 6)})
+                sw["current"] = None
+        # pick the next victim (deferred/retired rids skipped or dropped)
+        while sw["pending"]:
+            rid = sw["pending"][0]
+            r = self.replica_by_rid(rid)
+            if r is None or (r.state == "draining" and not r.swap_drain):
+                sw["pending"].pop(0)    # retired or retiring: nothing to swap
+                continue
+            if rid in self.swap_defer:
+                # deferred (session-pinned): try a later non-deferred rid
+                later = [x for x in sw["pending"]
+                         if x not in self.swap_defer
+                         and self.replica_by_rid(x) is not None]
+                if not later:
+                    return              # everything left is deferred: wait
+                rid = later[0]
+                r = self.replica_by_rid(rid)
+                sw["pending"].remove(rid)
+            else:
+                sw["pending"].pop(0)
+            if r.state != "healthy":
+                # fenced/warming: queue it back and wait for this cycle
+                sw["pending"].insert(0, rid)
+                return
+            r.state = "draining"
+            r.swap_drain = True
+            sw["current"], sw["phase"] = rid, "drain"
+            self._event({"kind": "swap_drain", "replica": rid,
+                         "t": round(now, 6), "inflight": r.inflight})
+            self._step_rollout(now)      # an idle victim installs at once
+            return
+        # pending empty and no current: the rollout is complete
+        self.swaps_completed += 1
+        self.last_rollout = {"checkpoint": sw["checkpoint"],
+                             "swapped": list(sw["swapped"])}
+        self._event({"kind": "swap_rollout_complete",
+                     "checkpoint": sw["checkpoint"],
+                     "swapped": list(sw["swapped"]),
+                     "t": round(now, 6)})
+        self._swap = None
+
+    def abort_rollout(self) -> List[int]:
+        """Stop an in-progress rollout (the rollback path): the
+        currently-draining victim is re-admitted un-swapped, and the
+        rids that already received new weights are returned so the
+        caller can reinstall the rollback tier on them.  No-op (empty
+        list) when no rollout is active — the exactly-once rollback
+        latch lives in the runtime, this is just the actuator."""
+        sw = self._swap
+        if sw is None:
+            return []
+        cur = self.replica_by_rid(sw["current"]) \
+            if sw["current"] is not None else None
+        if cur is not None and cur.swap_drain:
+            cur.swap_drain = False
+            if cur.state == "draining":
+                cur.state = "healthy"
+                cur.watchdog.reset()
+        swapped = list(sw["swapped"])
+        self._event({"kind": "swap_rollout_aborted",
+                     "checkpoint": sw["checkpoint"],
+                     "swapped": swapped,
+                     "t": round(self.clock.now(), 6)})
+        self._swap = None
+        return swapped
+
     # -- dispatch with failover ----------------------------------------------
-    def _fence(self, replica: Replica, err: ReplicaWedged) -> None:
-        restart_at = self.clock.now() + self.restart_s
+    def _fence(self, replica: Replica, err: ReplicaWedged,
+               at: Optional[float] = None) -> None:
+        """Fence ``replica``.  ``at`` pins the fence instant explicitly —
+        the parallel service model detects a crash/wedge at an instant it
+        computed on the replica's busy horizon, which the shared clock
+        has not reached yet."""
+        t = self.clock.now() if at is None else float(at)
+        restart_at = t + self.restart_s
         replica.fence(restart_at)
         self._event({"kind": "replica_fenced", "replica": replica.rid,
-                     "t": round(self.clock.now(), 6),
+                     "t": round(t, 6),
                      "restart_at": round(restart_at, 6),
                      "error": str(err).split("\n")[0][:160]})
         logger.warning("serving: fenced replica %d (%s); restart at t=%.3f",
@@ -558,9 +743,18 @@ class ReplicaPool:
         return replica.forward(batch, fault=fault)
 
     def snapshot(self) -> Dict[str, Any]:
-        return {
+        out = {
             "replicas": [{"rid": r.rid, "state": r.state,
                           "dispatches": r.dispatches, "wedges": r.wedges}
                          for r in self.replicas],
             "healthy": sum(r.state == "healthy" for r in self.replicas),
         }
+        if self.swaps_started:    # legacy snapshots stay byte-identical
+            out["rollouts"] = {
+                "started": self.swaps_started,
+                "completed": self.swaps_completed,
+                "active": self._swap is not None,
+                "last": dict(self.last_rollout) if self.last_rollout
+                else None,
+            }
+        return out

@@ -60,6 +60,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import logging
+import os
 from typing import (Any, Callable, Dict, List, Optional, Sequence, Set,
                     Tuple)
 
@@ -105,11 +106,19 @@ class ModelConfig:
     ladder and its weighted-EDF dispatch weight.  ``streaming`` marks a
     session-type model (``open_session``/``submit_chunk``) with
     ``chunk_deadline_s`` as the per-chunk incremental deadline.
+
+    ``weights_to_tiers``: ``(placed_variables, replica_rid) ->
+    [ServingTier]`` — how :meth:`ServingRuntime.hot_swap` turns a
+    checkpoint's (SpecSet-placed) variables into this model's tier
+    stack.  ``rid == -1`` builds the canary mirror (not bound to any
+    replica).  Without it the model cannot live-swap.
     """
 
     name: str
     tiers: Sequence[ServingTier]
     tier_factory: Optional[Callable[[int], Sequence[ServingTier]]] = None
+    weights_to_tiers: Optional[Callable[[Any, int],
+                                        Sequence[ServingTier]]] = None
     bucket_edges: Optional[Sequence[int]] = None
     pad_key: str = "input"
     length_key: Optional[str] = "n_frames"
@@ -264,16 +273,9 @@ class ServingRuntime:
         # the default: the PR-5/PR-11 drills replay byte-identically,
         # and chaos wedge/crash injection lives there.
         self.parallel = bool(parallel_replicas)
-        if self.parallel:
-            if service_time is None:
-                raise ValueError("parallel_replicas needs a service_time "
-                                 "model (it is a virtual-time mode)")
-            if chaos is not None:
-                raise ValueError("parallel_replicas does not support "
-                                 "chaos injection (serial mode does)")
-            if obs is not None:
-                raise ValueError("parallel_replicas does not thread "
-                                 "request spans (serial mode does)")
+        if self.parallel and service_time is None:
+            raise ValueError("parallel_replicas needs a service_time "
+                             "model (it is a virtual-time mode)")
         # telemetry spine (obs.Observability): request-lifecycle spans
         # into the flight recorder, metrics into the shared registry; a
         # replica fence dumps the black box when a dump_path is armed
@@ -300,6 +302,17 @@ class ServingRuntime:
                                registry=self.metrics.registry,
                                **(slo_params or {}))
         self.slo = slo
+        self._slo_params = dict(slo_params or {})
+        # live-weight hot-swap control (ISSUE 18): one rollout at a
+        # time — canary stage, then the pool's one-replica-at-a-time
+        # machine; _swap_ctl is None between rollouts, _swap_log keeps
+        # the banked history, _lkg the pending serve-LKG hysteresis
+        self._swap_ctl: Optional[Dict[str, Any]] = None
+        self._swap_counter = 0
+        self._swap_log: List[Dict[str, Any]] = []
+        self._swap_stats = {"completed": 0, "rollbacks": 0, "trips": 0,
+                            "lkg_promotions": 0}
+        self._lkg: Optional[Dict[str, Any]] = None
         self.autoscaler = autoscaler
         if autoscaler is not None and autoscaler.registry is None:
             autoscaler.registry = self.metrics.registry
@@ -418,6 +431,7 @@ class ServingRuntime:
             self.obs.dump("replica_fenced")
 
     def _end_request_spans(self, req: Request, status: str,
+                           at: Optional[float] = None,
                            **attrs: Any) -> None:
         if self.obs is None:
             return
@@ -426,8 +440,8 @@ class ServingRuntime:
             return
         d = spans.get("dispatch")
         if d is not None:
-            d.end(status=status, **attrs)
-        spans["root"].end(status=status)
+            d.end(status=status, at=at, **attrs)
+        spans["root"].end(status=status, at=at)
 
     # -- shed observer -------------------------------------------------------
     def _on_shed(self, req: Request, cause: str) -> None:
@@ -701,6 +715,7 @@ class ServingRuntime:
         assemble and dispatch every flush-ready batch.  Returns the
         number of batches dispatched.  Call after submits and after
         advancing the clock."""
+        self._swap_tick()
         dispatched = 0
         while True:
             if self.parallel and not force \
@@ -733,6 +748,362 @@ class ServingRuntime:
             if self.pump(force=True) == 0 and len(self.queue) == 0:
                 return
         raise RuntimeError("drain did not converge")
+
+    # -- live weights: hot-swap with canary + rollback (ISSUE 18) ------------
+    def hot_swap(self, checkpoint_path: str,
+                 model: Optional[str] = None, *,
+                 canary_fraction: float = 0.25,
+                 canary_min: int = 32,
+                 divergence_budget: float = 1e-3,
+                 latency_budget_s: Optional[float] = None,
+                 canary_seed: int = 0,
+                 lkg_after: int = 2,
+                 warm_s: Optional[float] = None) -> Dict[str, Any]:
+        """Start a zero-downtime weight rollout from a published
+        checkpoint snapshot:
+
+        1. **verify + load + place** — the snapshot's sha256 manifest is
+           verified, the pytree restored, and placed through the
+           pipeline's declared :class:`~analytics_zoo_tpu.parallel.
+           specs.SpecSet` (``place_state``) so the swap is mesh-correct
+           by construction;
+        2. **canary** — a seeded ``canary_fraction`` of this model's
+           live requests is MIRRORED to the new weights (one extra
+           forward per touched batch; the mirror never enters
+           ``accounting()``), per-row output divergence and modeled
+           latency land in rollout-labeled ``serve/canary/*`` metrics,
+           and a dedicated :class:`~analytics_zoo_tpu.obs.slo.
+           SloEvaluator` trips the stage the moment either crosses its
+           budget;
+        3. **rollout** — after ``canary_min`` clean mirrored requests
+           the pool's one-replica-at-a-time drain → install → re-warm →
+           rejoin machine takes over (session-pinned replicas last);
+        4. **rollback** — a tripped canary or a mid-rollout SLO trip
+           reverts to the previous weights (the ``serve-lkg`` tier's
+           content) EXACTLY once; a fully-healthy rollout instead
+           promotes this snapshot to ``serve-lkg`` after ``lkg_after``
+           clean decision windows (PR-3's hysteresis, serving twin).
+
+        Returns the rollout record (also appended to the swap log).
+        Raises :class:`CheckpointCorrupt` on a bad manifest — a
+        truncated publish never drains a replica."""
+        from analytics_zoo_tpu.parallel import checkpoint as ckpt
+        from analytics_zoo_tpu.resilience.errors import CheckpointCorrupt
+
+        cfg = self._resolve_model(model)
+        if cfg.weights_to_tiers is None:
+            raise ValueError(
+                f"model {cfg.name!r} declares no weights_to_tiers — the "
+                f"runtime cannot build its tier stack from a checkpoint")
+        if self._swap_ctl is not None \
+                and self._swap_ctl["phase"] in ("canary", "rolling"):
+            raise RuntimeError(
+                f"hot_swap: rollout of "
+                f"{self._swap_ctl['checkpoint']!r} still in progress")
+        now = self.clock.now()
+        try:
+            ckpt.verify_snapshot(checkpoint_path)
+            state = ckpt.load(checkpoint_path, verify=True)
+        except CheckpointCorrupt as e:
+            if self.obs is not None:
+                self.obs.recorder.note(
+                    "swap_rejected", checkpoint=checkpoint_path,
+                    error=str(e)[:160], t=round(now, 6))
+            raise
+        placed = self.specs.place_state(state) \
+            if self.specs is not None else state
+        mirror = list(cfg.weights_to_tiers(placed, -1))
+        if len(mirror) != len(cfg.tiers):
+            raise ValueError(
+                f"model {cfg.name!r}: weights_to_tiers built "
+                f"{len(mirror)} tiers, template declares "
+                f"{len(cfg.tiers)}")
+        k = self._swap_counter
+        self._swap_counter += 1
+        from analytics_zoo_tpu.obs.slo import SloEvaluator, canary_slos
+
+        window_params = {key: v for key, v in self._slo_params.items()
+                         if key in ("fast_window_s", "slow_window_s",
+                                    "time_scale", "timeline_cap")}
+        evaluator = SloEvaluator(
+            slos=canary_slos(cfg.name, divergence_budget,
+                             latency_budget_s, rollout=k),
+            registry=self.metrics.registry,
+            fast_burn=1.0, slow_burn=1.0, **window_params)
+        self._lkg = None   # a new rollout supersedes a pending promotion
+        self._swap_ctl = {
+            "phase": "canary", "model": cfg.name, "rollout": k,
+            "checkpoint": checkpoint_path, "placed": placed,
+            "mirror": mirror, "fraction": float(canary_fraction),
+            "min": int(canary_min), "seed": int(canary_seed),
+            "mirrored": 0, "evaluator": evaluator,
+            "lkg_after": int(lkg_after), "warm_s": warm_s,
+            "rolled_back": False, "stash": {}, "t_started": now,
+        }
+        self.metrics.registry.counter("serve/swap/rollouts").inc()
+        if self.autoscaler is not None:
+            # canary verdicts must not be masked by fresh capacity —
+            # the loop keeps observing but actuations are swallowed
+            self.autoscaler.hold = True
+        if self.obs is not None:
+            self.obs.recorder.note(
+                "swap_started", model=cfg.name, rollout=k,
+                checkpoint=checkpoint_path,
+                canary_fraction=float(canary_fraction),
+                canary_min=int(canary_min),
+                divergence_budget=divergence_budget, t=round(now, 6))
+        record = {"rollout": k, "model": cfg.name,
+                  "checkpoint": checkpoint_path, "outcome": None,
+                  "t_started": round(now, 6)}
+        self._swap_log.append(record)
+        if canary_fraction <= 0 or canary_min <= 0:
+            self._begin_roll()   # canary explicitly disabled
+        return record
+
+    @property
+    def swap_active(self) -> bool:
+        """Whether a rollout is in flight (canary or rolling) — the
+        gate a checkpoint-watching driver checks before starting the
+        next ``hot_swap`` (one rollout at a time; a newly-published
+        snapshot waits its turn)."""
+        return (self._swap_ctl is not None
+                and self._swap_ctl["phase"] in ("canary", "rolling"))
+
+    @property
+    def lkg_pending(self) -> bool:
+        """Whether a completed rollout is still inside its serve-LKG
+        hysteresis (clean decision windows not yet accumulated).  A
+        driver that starts the next ``hot_swap`` now supersedes the
+        pending promotion — waiting for this to clear is how each
+        fully-healthy rollout actually lands in the ``serve-lkg``
+        tier."""
+        return self._lkg is not None
+
+    def _swap_install(self, replica: Replica) -> None:
+        """The pool rollout's install hook: stash the replica's live
+        tier stack for this model (the rollback inventory — still
+        jit-warm), then mount the new-weights tiers built for THIS
+        rid (per-replica stores stay per-replica)."""
+        ctl = self._swap_ctl
+        name = ctl["model"]
+        ctl["stash"][replica.rid] = (replica.forward_fns.get(name),
+                                     replica.tier_objs.get(name))
+        tiers = list(self.models[name].weights_to_tiers(
+            ctl["placed"], replica.rid))
+        replica.forward_fns[name] = [t.forward for t in tiers]
+        replica.tier_objs[name] = tiers
+        self.metrics.registry.counter("serve/swap/replicas_swapped").inc()
+
+    def _begin_roll(self) -> None:
+        ctl = self._swap_ctl
+        ctl["phase"] = "rolling"
+        self.pool.swap_defer = set(self._session_rids())
+        self.pool.hot_swap(ctl["checkpoint"], install=self._swap_install,
+                           warm_s=ctl["warm_s"],
+                           last=sorted(self._session_rids()))
+        if self.autoscaler is not None:
+            self.autoscaler.hold = False
+        if self.obs is not None:
+            self.obs.recorder.note(
+                "swap_rolling", model=ctl["model"],
+                rollout=ctl["rollout"], mirrored=ctl["mirrored"],
+                t=round(self.clock.now(), 6))
+
+    def _swap_tick(self) -> None:
+        """Advance swap bookkeeping once per pump: refresh the deferred
+        (session-pinned) rid set, let the pool machine step, and detect
+        rollout completion (which arms the serve-LKG hysteresis)."""
+        ctl = self._swap_ctl
+        if ctl is None or ctl["phase"] != "rolling":
+            return
+        self.pool.swap_defer = set(self._session_rids())
+        self.pool.healthy()          # runs _revive → _step_rollout
+        if self.pool.rollout_active:
+            return
+        ctl["phase"] = "complete"
+        ctl["stash"] = {}            # old weights no longer needed
+        self._swap_stats["completed"] += 1
+        self._swap_log[-1]["outcome"] = "complete"
+        swapped = (self.pool.last_rollout or {}).get("swapped", [])
+        self._lkg = {"ctl": ctl, "clean": 0,
+                     "after": ctl["lkg_after"]}
+        if self.obs is not None:
+            self.obs.recorder.note(
+                "swap_complete", model=ctl["model"],
+                rollout=ctl["rollout"], replicas=list(swapped),
+                t=round(self.clock.now(), 6))
+
+    def _maybe_canary(self, batch: AssembledBatch, rows,
+                      now: float) -> None:
+        """Canary mirroring on the live dispatch path: a seeded
+        fraction of this model's requests also runs on the new-weights
+        mirror tier; per-row divergence + modeled latency land in the
+        rollout-labeled registry names and the canary evaluator trips
+        the stage on budget.  The mirror NEVER touches the request
+        lifecycle — ``accounting()`` is conserved by construction."""
+        ctl = self._swap_ctl
+        if ctl is None or ctl["phase"] != "canary" \
+                or batch.model != ctl["model"]:
+            return
+        gate = int(ctl["fraction"] * 1000)
+        sel = [i for i, r in enumerate(batch.requests)
+               if not r.finished
+               and (r.rid * 1_000_003 + ctl["seed"]) % 1000 < gate]
+        if not sel:
+            return
+        m, k = ctl["model"], ctl["rollout"]
+        reg = self.metrics.registry
+        reg.counter(f"serve/canary/mirrored/model={m}").inc(len(sel))
+        ctl["mirrored"] += len(sel)
+        div_h = reg.histogram(
+            f"serve/canary/divergence/model={m}/swap={k}")
+        mirror_tier = ctl["mirror"][batch.tier]
+        try:
+            mrows = np.asarray(mirror_tier.forward(batch.batch))
+            for i in sel:
+                a, b = rows[i], mrows[i]
+                if isinstance(a, (str, bytes, np.str_)):
+                    div = 0.0 if a == b else 1.0
+                else:
+                    d = np.abs(np.asarray(a, dtype=np.float64)
+                               - np.asarray(b, dtype=np.float64))
+                    div = float(np.max(d)) if d.size else 0.0
+                div_h.observe(div)
+        except Exception as err:
+            # a crashing canary forward is itself a tripworthy signal
+            div_h.observe(float("inf"))
+            if self.obs is not None:
+                self.obs.recorder.note(
+                    "canary_error", model=m, rollout=k,
+                    error=f"{type(err).__name__}: {err}"[:160],
+                    t=round(now, 6))
+        if self._service_time is not None:
+            live = float(self._service_hook(batch, -1))
+            template = self.models[m].tiers[batch.tier]
+            ratio = (template.speed / mirror_tier.speed
+                     if getattr(mirror_tier, "speed", 0) else 1.0)
+            reg.histogram(
+                f"serve/canary/latency_s/model={m}/swap={k}"
+            ).observe(live * ratio)
+        ev = ctl["evaluator"]
+        ev.observe_registry(reg, now)
+        decision = ev.decide(now)
+        if decision.burning:
+            self._swap_stats["trips"] += 1
+            reg.counter("serve/canary/trips").inc()
+            if self.obs is not None:
+                self.obs.recorder.note(
+                    "canary_trip", model=m, rollout=k,
+                    burning=list(decision.burning),
+                    mirrored=ctl["mirrored"], t=round(now, 6))
+            self._swap_rollback("canary_trip: "
+                                + ",".join(decision.burning))
+        elif ctl["mirrored"] >= ctl["min"]:
+            self._begin_roll()
+
+    def _swap_rollback(self, reason: str) -> None:
+        """Revert the rollout to the previous weights (the content of
+        the ``serve-lkg`` tier) EXACTLY once — the ``rolled_back``
+        latch makes a canary trip racing a mid-rollout anomaly
+        idempotent.  Already-swapped replicas get their stashed (still
+        jit-warm) tier stacks back instantly; a replica with no stash
+        (grown mid-rollout) is rebuilt from the verified ``serve-lkg``
+        snapshot when one exists."""
+        ctl = self._swap_ctl
+        if ctl is None or ctl["rolled_back"]:
+            return
+        ctl["rolled_back"] = True
+        now = self.clock.now()
+        swapped = self.pool.abort_rollout()
+        missing: List[int] = []
+        for rid in swapped:
+            r = self.pool.replica_by_rid(rid)
+            if r is None:
+                continue
+            stash = ctl["stash"].get(rid)
+            if stash is not None and stash[0] is not None:
+                r.forward_fns[ctl["model"]] = stash[0]
+                r.tier_objs[ctl["model"]] = stash[1]
+            else:
+                missing.append(rid)
+        lkg_path = None
+        if missing:
+            from analytics_zoo_tpu.parallel import checkpoint as ckpt
+
+            base = os.path.dirname(os.path.abspath(ctl["checkpoint"]))
+            found = ckpt.tier_snapshot(base, "serve-lkg")
+            if found is not None:
+                lkg_path = found[0]
+                state = ckpt.load(lkg_path, verify=False)
+                placed = self.specs.place_state(state) \
+                    if self.specs is not None else state
+                for rid in missing:
+                    r = self.pool.replica_by_rid(rid)
+                    tiers = list(self.models[ctl["model"]]
+                                 .weights_to_tiers(placed, rid))
+                    r.forward_fns[ctl["model"]] = [t.forward
+                                                   for t in tiers]
+                    r.tier_objs[ctl["model"]] = tiers
+        ctl["phase"] = "rolled_back"
+        ctl["stash"] = {}
+        self._swap_stats["rollbacks"] += 1
+        self._swap_log[-1]["outcome"] = "rolled_back"
+        self._swap_log[-1]["reason"] = reason[:160]
+        self.metrics.registry.counter("serve/swap/rollbacks").inc()
+        self._lkg = None
+        if self.autoscaler is not None:
+            self.autoscaler.hold = False
+        if self.obs is not None:
+            self.obs.recorder.note(
+                "swap_rollback", model=ctl["model"],
+                rollout=ctl["rollout"], reason=reason[:160],
+                reverted=list(swapped), lkg=lkg_path,
+                t=round(now, 6))
+            if self.obs.dump_path:
+                self.obs.dump("swap_rollback")
+
+    def _maybe_promote_lkg(self, decision) -> None:
+        """Serve-LKG hysteresis (the PR-3 pattern): after a completed
+        rollout, ``lkg_after`` consecutive clean decision windows
+        promote the swapped snapshot into the ``serve-lkg`` tier; a
+        trip resets the streak (and a mid-rollout trip rolls back via
+        ``_decide_window`` before ever reaching here)."""
+        pend = self._lkg
+        if pend is None:
+            return
+        model = pend["ctl"]["model"]
+        dirty = any(self._slo_model.get(s) == model
+                    for s in decision.burning)
+        if dirty:
+            pend["clean"] = 0
+            return
+        pend["clean"] += 1
+        if pend["clean"] < pend["after"]:
+            return
+        from analytics_zoo_tpu.parallel import checkpoint as ckpt
+        from analytics_zoo_tpu.resilience.errors import CheckpointCorrupt
+
+        snap = pend["ctl"]["checkpoint"]
+        base = os.path.dirname(os.path.abspath(snap))
+        self._lkg = None
+        try:
+            target = ckpt.promote_tier(base, snap, "serve-lkg")
+        except (CheckpointCorrupt, OSError) as e:
+            # the trainer may have GC'd the step snapshot already —
+            # a missed promotion is not a serving fault
+            if self.obs is not None:
+                self.obs.recorder.note(
+                    "swap_lkg_failed", checkpoint=snap,
+                    error=str(e)[:160],
+                    t=round(self.clock.now(), 6))
+            return
+        self._swap_stats["lkg_promotions"] += 1
+        self.metrics.registry.counter("serve/swap/lkg_promotions").inc()
+        if self.obs is not None:
+            self.obs.recorder.note(
+                "swap_lkg_promoted", checkpoint=snap, tier=target,
+                rollout=pend["ctl"]["rollout"],
+                t=round(self.clock.now(), 6))
 
     # -- internals -----------------------------------------------------------
     def _fault_for(self, replica: Replica) -> Optional[Callable]:
@@ -828,6 +1199,7 @@ class ServingRuntime:
             return
         now = self.clock.now()
         rows = np.asarray(out)
+        self._maybe_canary(batch, rows, now)
         for i, req in enumerate(batch.requests):
             if req.finished:            # scrubbed dead-session row
                 continue
@@ -846,19 +1218,227 @@ class ServingRuntime:
             batch_span.end(status="done", redispatched=batch.redispatched)
         self._after_dispatch(batch, t0, failed=False)
 
+    def _parallel_fault(self, replica: Replica) -> Tuple[bool, float]:
+        """Chaos windows for the current dispatch index against
+        ``replica`` under the parallel service model: ``(crash,
+        delay_s)``.  The windows are the same ``serving_active`` queries
+        the serial ``_fault_for`` composes; here the effects are applied
+        to the replica's OWN busy horizon instead of the shared clock."""
+        if self.chaos is None:
+            return False, 0.0
+        idx = self._dispatch_idx
+        delay = 0.0
+        spec = self.chaos.serving_active("slow_forward", idx, consume=False)
+        if spec is not None and spec.detail.get(
+                "replica", replica.rid) == replica.rid:
+            self.chaos.serving_active("slow_forward", idx)  # record+consume
+            delay = float(spec.detail.get("delay_s", 2.0))
+        crash = False
+        spec = self.chaos.serving_active("replica_crash", idx, consume=False)
+        if spec is not None and spec.detail.get(
+                "replica", replica.rid) == replica.rid:
+            self.chaos.serving_active("replica_crash", idx)
+            crash = True
+        return crash, delay
+
     def _dispatch_parallel(self, batch: AssembledBatch) -> None:
         """Parallel-service dispatch: assign the batch to a free (or,
         for sessions/force-drain, the pinned/least-busy) replica; its
         completion lands at ``start + cold_tax + service`` on THAT
         replica's busy horizon while the shared clock stands still —
         replicas serve concurrently, so resizing the pool really
-        changes capacity (what the fleet drill measures)."""
+        changes capacity (what the fleet drill measures).
+
+        Chaos + failover compose here too (ISSUE 18): an injected crash
+        fences the replica at the instant the batch would have started
+        on its horizon, a ``slow_forward`` wedge is detected at the
+        fence budget (or, without one, when the slow forward returns) —
+        and the batch re-dispatches EXACTLY once through the same
+        ``redispatched`` latch as serial mode.  Request spans thread
+        through unchanged: dispatch/root spans end AT the computed
+        completion instant (``Span.end(at=)``), so az-trace tail
+        attribution covers fleet drills."""
         self._dispatch_idx += 1
         self.metrics.on_batch(batch.n_valid,
                               self.batcher.model_batch(batch.model),
                               self.queue.depth)
         now = self.clock.now()
         model_label = batch.model if self._multi else None
+        batch_span = None
+        if self.obs is not None:
+            batch_span = self.obs.tracer.start(
+                "batch", f"batch-{self._dispatch_idx}",
+                requests=[r.rid for r in batch.requests],
+                edge=str(batch.edge), n_valid=batch.n_valid,
+                tier=batch.tier)
+            for req in batch.requests:
+                spans = self._spans.get(req.rid)
+                if spans is None:
+                    continue
+                q = spans.pop("queue", None)
+                if q is not None:
+                    q.end(status="assembled", edge=str(batch.edge))
+                spans["dispatch"] = self.obs.tracer.start(
+                    "dispatch", spans["root"].trace_id,
+                    parent=spans["root"], tier=batch.tier,
+                    batch=self._dispatch_idx)
+
+        def fail_batch(err: BaseException, at: float) -> None:
+            for req in batch.requests:
+                if req.finished:        # scrubbed dead-session row
+                    continue
+                req.finish("failed", at, error=err)
+                self._account_terminal(req)
+                self.metrics.on_fail(model=model_label)
+                self._end_request_spans(req, "failed", at=at,
+                                        attempts=req.attempts)
+                if req.session is not None:
+                    self._kill_session(req, str(err))
+            if batch_span is not None:
+                batch_span.end(status="failed", at=at,
+                               redispatched=batch.redispatched)
+            if batch.redispatched:
+                self.metrics.redispatches += 1
+            self._since_decision += 1
+            if self._since_decision >= self.decision_every:
+                self._decide_window()
+
+        def complete(replica: Replica, out: Any, start: float,
+                     elapsed: float) -> None:
+            completion = start + elapsed
+            replica.busy_until = completion
+            rows = np.asarray(out)
+            self._maybe_canary(batch, rows, now)
+            for i, req in enumerate(batch.requests):
+                if req.finished:        # scrubbed dead-session row
+                    continue
+                req.tier = batch.tier
+                req.finish("done", completion,
+                           result=rows[i] if self.retain_requests
+                           else None)
+                self._account_terminal(req)
+                missed = completion > req.deadline_t
+                self.metrics.on_complete(completion - req.arrival_t,
+                                         batch.tier, missed=missed,
+                                         model=model_label)
+                self._end_request_spans(req, "done", at=completion,
+                                        attempts=req.attempts,
+                                        missed=missed)
+                if req.final and req.session is not None:
+                    self._release_session(req.session)
+            if batch_span is not None:
+                batch_span.end(status="done", at=completion,
+                               redispatched=batch.redispatched)
+            if batch.redispatched:
+                self.metrics.redispatches += 1
+            self._since_decision += 1
+            if self._since_decision >= self.decision_every:
+                self._decide_window()
+
+        def wedge(replica: Replica, err: ReplicaWedged, at: float,
+                  is_backup: bool) -> None:
+            replica.busy_until = at
+            self.pool._fence(replica, err, at=at)
+            failover(replica, err, at, is_backup)
+
+        def serve_on(replica: Replica, t_avail: float,
+                     is_backup: bool) -> None:
+            """One service attempt on ``replica``'s busy horizon,
+            mirroring the serial ``Replica.forward`` time order —
+            injected delay, cold compile, model fn, service — with the
+            fence budget cutting the cumulative elapsed exactly where
+            ``sleep_guarded`` would.  A chaos crash/wedge fences the
+            replica at the computed instant and (for the primary, on a
+            non-affine batch) falls through to failover."""
+            for req in batch.requests:
+                req.attempts += 1
+            replica.dispatches += 1
+            crash, delay = self._parallel_fault(replica)
+            start = max(t_avail, replica.busy_until)
+            budget = replica.fence_budget_s
+            chaotic = crash or delay > 0
+            if chaotic and budget is not None and delay > budget:
+                # the injected stall alone crosses the budget: fenced
+                # mid-delay, before compile/fn would even run
+                wedge(replica, ReplicaWedged(
+                    f"replica {replica.rid}: forward wedged mid-flight "
+                    f"— fenced at the {budget:.3f}s fence budget"),
+                    start + budget, is_backup)
+                return
+            if crash:
+                # serial ordering: the slow_forward hook sleeps first,
+                # then the crash hook raises — the kill lands at
+                # start + delay on this replica's horizon
+                wedge(replica, ReplicaWedged(
+                    f"replica {replica.rid}: forward crashed mid-batch "
+                    f"(InjectedFault: chaos: replica {replica.rid} "
+                    f"killed mid-batch)"), start + delay, is_backup)
+                return
+            try:
+                out = replica._fn_for(batch)(batch.batch)
+            except Exception as e:
+                err = e if isinstance(e, ReplicaWedged) else ReplicaWedged(
+                    f"replica {replica.rid}: forward crashed mid-batch "
+                    f"({type(e).__name__}: {e})")
+                fail_batch(err, start)
+                return
+            tax = replica.cold_tax(batch, mark=False)
+            if chaotic and budget is not None and delay + tax > budget:
+                # fenced mid-compile: the geometry stays COLD for the
+                # restarted replica (mirrors _maybe_cold_compile)
+                wedge(replica, ReplicaWedged(
+                    f"replica {replica.rid}: forward wedged mid-flight "
+                    f"— fenced at the {budget:.3f}s fence budget"),
+                    start + budget, is_backup)
+                return
+            if tax > 0 and replica.warm_keys is not None:
+                replica.warm_keys.add((batch.model, batch.edge,
+                                       batch.tier))
+            service = float(self._service_hook(batch, replica.rid))
+            elapsed = delay + tax + service
+            if chaotic and budget is not None and elapsed > budget:
+                # fence-budget semantics on the replica's OWN busy
+                # horizon: the wedge is observed at start + budget
+                wedge(replica, ReplicaWedged(
+                    f"replica {replica.rid}: forward wedged mid-flight "
+                    f"— fenced at the {budget:.3f}s fence budget"),
+                    start + budget, is_backup)
+                return
+            if chaotic and elapsed > replica.watchdog.timeout_s:
+                # no budget: return-then-check — the wedge rides out
+                # the whole stall before it is observed
+                wedge(replica, ReplicaWedged(
+                    f"replica {replica.rid}: forward wedged "
+                    f"({elapsed:.3f}s > "
+                    f"{replica.watchdog.timeout_s:.3f}s deadline)"),
+                    start + elapsed, is_backup)
+                return
+            complete(replica, out, start, elapsed)
+
+        def failover(failed: Replica, err: ReplicaWedged,
+                     t_detect: float, is_backup: bool) -> None:
+            if is_backup or batch.redispatched \
+                    or batch.affinity is not None:
+                # latch spent, or a session batch (its carry lives on
+                # the failed replica — honest state loss)
+                fail_batch(err, t_detect)
+                return
+            batch.redispatched = True
+            backup = self.pool.pick_free(t_detect, exclude=failed.rid)
+            if backup is None:
+                backup = self.pool.least_busy()
+            if backup is None:
+                fail_batch(ReplicaWedged(
+                    f"batch failover from replica {failed.rid}: no "
+                    f"healthy replica left"), t_detect)
+                return
+            self.pool._event({"kind": "failover", "from": failed.rid,
+                              "to": backup.rid,
+                              "t": round(t_detect, 6),
+                              "requests": [r.rid
+                                           for r in batch.requests]})
+            serve_on(backup, t_detect, is_backup=True)
+
         if batch.affinity is not None:
             self.pool._revive()
             replica = self.pool.replica_by_rid(batch.affinity)
@@ -870,63 +1450,13 @@ class ServingRuntime:
                 # force-drain path: queue the batch on the least-busy
                 # replica (starts when it frees)
                 replica = self.pool.least_busy()
-        def fail_batch(err: BaseException) -> None:
-            for req in batch.requests:
-                if req.finished:        # scrubbed dead-session row
-                    continue
-                req.finish("failed", now, error=err)
-                self._account_terminal(req)
-                self.metrics.on_fail(model=model_label)
-                if req.session is not None:
-                    self._kill_session(req, str(err))
-            self._since_decision += 1
-            if self._since_decision >= self.decision_every:
-                self._decide_window()
-
         if replica is None:
             fail_batch(ReplicaWedged(
                 f"no replica available for model {batch.model!r}"
                 + (f" (session pinned to {batch.affinity})"
-                   if batch.affinity is not None else "")))
+                   if batch.affinity is not None else "")), now)
             return
-        # run the real forward BEFORE committing the busy horizon: a
-        # crashing forward fails its requests outright without charging
-        # the replica for service it never rendered.  NOTE: unlike the
-        # serial path, parallel mode has NO failover redispatch — the
-        # fence/retry story lives in serial mode (chaos drills); chaos
-        # + failover under the parallel service model is ROADMAP
-        # item-1 follow-up work
-        try:
-            out = replica._fn_for(batch)(batch.batch)
-        except Exception as err:
-            fail_batch(err if isinstance(err, ReplicaWedged)
-                       else ReplicaWedged(
-                           f"replica {replica.rid}: forward crashed "
-                           f"mid-batch ({type(err).__name__}: {err})"))
-            return
-        start = max(now, replica.busy_until)
-        tax = replica.cold_tax(batch)
-        service = float(self._service_hook(batch, replica.rid))
-        completion = start + tax + service
-        replica.busy_until = completion
-        replica.dispatches += 1
-        rows = np.asarray(out)
-        for i, req in enumerate(batch.requests):
-            if req.finished:            # scrubbed dead-session row
-                continue
-            req.tier = batch.tier
-            req.finish("done", completion,
-                       result=rows[i] if self.retain_requests else None)
-            self._account_terminal(req)
-            missed = completion > req.deadline_t
-            self.metrics.on_complete(completion - req.arrival_t,
-                                     batch.tier, missed=missed,
-                                     model=model_label)
-            if req.final and req.session is not None:
-                self._release_session(req.session)
-        self._since_decision += 1
-        if self._since_decision >= self.decision_every:
-            self._decide_window()
+        serve_on(replica, now, is_backup=False)
 
     def _after_dispatch(self, batch: AssembledBatch, t0: float,
                         failed: bool) -> None:
@@ -965,6 +1495,17 @@ class ServingRuntime:
                 self._observe_multi(decision, detail)
             else:
                 self.ladder.observe_decision(decision, detail=detail)
+            # mid-rollout anomaly: a fresh trip of the swapped model's
+            # SLOs while replicas are still being swapped rolls back
+            ctl = self._swap_ctl
+            if ctl is not None and ctl["phase"] == "rolling" \
+                    and decision.new_trips:
+                hit = [s for s in decision.new_trips
+                       if self._slo_model.get(s) == ctl["model"]]
+                if hit:
+                    self._swap_rollback(
+                        "mid_rollout_anomaly: " + ",".join(hit))
+            self._maybe_promote_lkg(decision)
             if self.autoscaler is not None:
                 self._actuate(decision)
         else:
@@ -1027,9 +1568,15 @@ class ServingRuntime:
                                                   self.pool.size)
         if target is None:
             return
+        protected = self._session_rids()
+        if self.pool._swap is not None \
+                and self.pool._swap["current"] is not None:
+            # the rollout's current victim is mid-drain/warm: retiring
+            # it would silently skip its swap step
+            protected.add(self.pool._swap["current"])
         actions = self.pool.resize(target,
                                    prewarm=self.autoscaler.policy.prewarm,
-                                   protected=sorted(self._session_rids()))
+                                   protected=sorted(protected))
         if self.obs is not None:
             self.obs.recorder.note(
                 "autoscale", t=round(self.clock.now(), 6),
@@ -1103,4 +1650,15 @@ class ServingRuntime:
             out["slo"] = {k: r[k] for k in
                           ("slos", "windows", "decisions", "trips",
                            "peak_burns")}
+        if self._swap_counter:
+            # keyed in only once hot_swap was used (legacy snapshots
+            # byte-identical)
+            out["swap"] = {
+                "rollouts": self._swap_counter,
+                "completed": self._swap_stats["completed"],
+                "rollbacks": self._swap_stats["rollbacks"],
+                "trips": self._swap_stats["trips"],
+                "lkg_promotions": self._swap_stats["lkg_promotions"],
+                "history": [dict(h) for h in self._swap_log],
+            }
         return out

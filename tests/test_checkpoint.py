@@ -254,3 +254,98 @@ class TestLkgTier:
     def test_unknown_tier_rejected(self, tmp_path):
         with pytest.raises(ValueError, match="unknown checkpoint tier"):
             ckpt.save(str(tmp_path / "c"), _tree(1.0), tier="bogus")
+
+
+class TestServeLkgPromotionAndWatcher:
+    """ISSUE 18 plumbing: ``promote_tier`` (the serving hot-swap's
+    serve-LKG promotion — exact published bytes, never re-serialized)
+    and ``CheckpointWatcher`` (the serving side's "new publish?" poll)."""
+
+    def test_promote_copies_exact_bytes_and_records_source(self, tmp_path):
+        base = str(tmp_path / "c")
+        snap = ckpt.save(base, _tree(4.0), step=7, meta={"iteration": 70})
+        target = ckpt.promote_tier(base, snap, "serve-lkg")
+        assert os.path.basename(target) == "serve-lkg"
+        found = ckpt.tier_snapshot(base, "serve-lkg")
+        assert found is not None
+        tier_dir, man = found
+        assert tier_dir == target
+        assert man["meta"]["tier"] == "serve-lkg"
+        assert man["meta"]["promoted_from"] == "step_7"
+        assert man["meta"]["iteration"] == 70      # source meta carried
+        np.testing.assert_array_equal(
+            np.asarray(ckpt.load(tier_dir, verify=True)["w"]),
+            _tree(4.0)["w"])
+        # the source snapshot is untouched (promotion is a copy)
+        assert float(ckpt.load(snap)["w"][0, 0]) == 4.0
+
+    def test_promote_refuses_corrupt_source(self, tmp_path):
+        """Never promote bytes we can't vouch for: a corrupt source
+        snapshot fails verification and the tier slot stays absent."""
+        base = str(tmp_path / "c")
+        snap = ckpt.save(base, _tree(1.0), step=1)
+        man = ckpt.verify_snapshot(snap)
+        rel = max(man["files"], key=lambda r: man["files"][r]["size"])
+        full = os.path.join(snap, rel)
+        data = bytearray(open(full, "rb").read())
+        data[-1] ^= 0xFF
+        open(full, "wb").write(bytes(data))
+        with pytest.raises(CheckpointCorrupt):
+            ckpt.promote_tier(base, snap, "serve-lkg")
+        assert ckpt.tier_snapshot(base, "serve-lkg") is None
+
+    def test_promote_overwrites_previous_slot(self, tmp_path):
+        base = str(tmp_path / "c")
+        s1 = ckpt.save(base, _tree(1.0), step=1)
+        s2 = ckpt.save(base, _tree(2.0), step=2)
+        ckpt.promote_tier(base, s1, "serve-lkg")
+        ckpt.promote_tier(base, s2, "serve-lkg")
+        tier_dir, man = ckpt.tier_snapshot(base, "serve-lkg")
+        assert man["meta"]["promoted_from"] == "step_2"
+        assert float(ckpt.load(tier_dir)["w"][0, 0]) == 2.0
+
+    def test_promote_unknown_tier_rejected(self, tmp_path):
+        base = str(tmp_path / "c")
+        snap = ckpt.save(base, _tree(1.0), step=1)
+        with pytest.raises(ValueError, match="unknown checkpoint tier"):
+            ckpt.promote_tier(base, snap, "bogus")
+
+    def test_watcher_reports_each_publish_once(self, tmp_path):
+        base = str(tmp_path / "c")
+        ckpt.save(base, _tree(1.0), step=1)
+        w = ckpt.CheckpointWatcher(base)
+        assert w.poll() is None            # baselined at construction
+        t2 = ckpt.save(base, _tree(2.0), step=2)
+        found = w.poll()
+        assert found is not None and found[0] == t2
+        assert w.poll() is None            # seen: reported exactly once
+        t3 = ckpt.save(base, _tree(3.0), step=3)
+        assert w.poll()[0] == t3
+
+    def test_watcher_ignores_tier_promotions(self, tmp_path):
+        """A serve-LKG promotion (or LKG rollback target refresh) must
+        not retrigger the watcher — tier slots are never restore
+        candidates, so they are not 'new publishes' either."""
+        base = str(tmp_path / "c")
+        snap = ckpt.save(base, _tree(1.0), step=1)
+        w = ckpt.CheckpointWatcher(base)
+        ckpt.promote_tier(base, snap, "serve-lkg")
+        ckpt.save(base, _tree(0.5), tier="lkg")
+        assert w.poll() is None
+
+    def test_watcher_skips_corrupt_publish_until_fixed(self, tmp_path):
+        """A truncated publish is invisible to the watcher (it would
+        fail hot_swap's verification anyway); the next intact publish
+        is reported normally."""
+        base = str(tmp_path / "c")
+        ckpt.save(base, _tree(1.0), step=1)
+        w = ckpt.CheckpointWatcher(base)
+        t2 = ckpt.save(base, _tree(2.0), step=2)
+        man = ckpt.read_manifest(t2)
+        rel = max(man["files"], key=lambda r: man["files"][r]["size"])
+        full = os.path.join(t2, rel)
+        with open(full, "r+b") as f:
+            f.truncate(os.path.getsize(full) // 2)
+        assert w.poll() is None            # corrupt: not a publish
+        t3 = ckpt.save(base, _tree(3.0), step=3)
+        assert w.poll()[0] == t3

@@ -1,0 +1,411 @@
+"""Live-weight hot-swap (ISSUE 18): zero-downtime checkpoint rollout
+with canary + LKG rollback on the serving runtime.
+
+The unit surface under test is ``ServingRuntime.hot_swap`` end to end:
+manifest-verified load, the canary mirror stage (a seeded fraction of
+live requests ALSO runs on the new weights — never entering
+``accounting()``), the pool's one-replica-at-a-time drain → install →
+rejoin machine, the exactly-once rollback latch, and the serve-LKG
+promotion hysteresis.  The integrated scenario (diurnal fleet traffic,
+poisoned publish, chaos mid-rollout, streaming sessions) is banked by
+``tools/live_swap_drill.py`` and asserted in test_tools.py — these
+tests cover each failure branch in isolation on a toy linear model
+whose output makes weight identity directly observable.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from analytics_zoo_tpu.obs.slo import model_slos
+from analytics_zoo_tpu.parallel import checkpoint as ckpt
+from analytics_zoo_tpu.resilience.chaos import ChaosMonkey, FaultSpec
+from analytics_zoo_tpu.resilience.errors import CheckpointCorrupt
+from analytics_zoo_tpu.serving import (ModelConfig, ServingRuntime,
+                                       ServingTier, VirtualClock)
+
+D = 4   # toy feature dim: ones(1, D) @ full((D, D), v) == row of D * v
+
+
+def _state(v: float):
+    return {"w": np.full((D, D), float(v), np.float32)}
+
+
+def _tiers(state):
+    w = np.asarray(state["w"], np.float64)
+
+    def fwd(batch, _w=w):
+        return np.asarray(batch["input"], np.float64) @ _w
+
+    return [ServingTier("fp", fwd), ServingTier("int8", fwd, 0.8)]
+
+
+def _config(state):
+    return ModelConfig(
+        name="m", tiers=_tiers(state),
+        weights_to_tiers=lambda placed, rid: _tiers(placed),
+        length_key=None, default_deadline_s=5.0,
+        slos=model_slos("m", miss_budget=0.9, shed_budget=0.9))
+
+
+def _runtime(state, **kw):
+    kw.setdefault("n_replicas", 2)
+    kw.setdefault("queue_capacity", 256)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("decision_every", 4)
+    kw.setdefault("service_time", lambda m, e, n, t: 0.005)
+    kw.setdefault("slo_params", dict(time_scale=0.01))
+    clock = VirtualClock()
+    return ServingRuntime(models=[_config(state)], clock=clock, **kw), clock
+
+
+def _feed(rt, clock, n, dt=0.05, model="m"):
+    for _ in range(n):
+        rt.submit({"input": np.ones((1, D), np.float32)}, model=model)
+        clock.advance(dt)
+        rt.pump()
+
+
+def _served_value(rt) -> float:
+    """Dispatch one probe request and return its (scalar) output — the
+    weight value every healthy replica currently serves, times D."""
+    r = rt.submit({"input": np.ones((1, D), np.float32)}, model="m")
+    rt.drain()
+    assert r.state == "done"
+    return float(np.asarray(r.result).ravel()[0])
+
+
+def _settle(rt, clock, limit=20_000):
+    """Parallel-mode drain: advance virtual time through the pool's
+    event horizon until every request is terminal and no rollout is in
+    flight."""
+    for _ in range(limit):
+        if rt.pump(force=True):
+            continue
+        if rt.accounting()["unaccounted"] == 0 and not rt.swap_active \
+                and not rt.pool.rollout_active:
+            return
+        nxt = rt.next_event_t()
+        step = (nxt - clock.now()) if nxt is not None else 0.01
+        clock.advance(max(step, 1e-6))
+    raise RuntimeError("parallel runtime did not settle")
+
+
+class TestHotSwapRollout:
+    def test_full_rollout_swaps_weights_and_conserves_accounting(
+            self, tmp_path):
+        """Happy path: canary mirrors a fraction of live traffic (never
+        entering accounting), then every replica drains → installs →
+        rejoins and the fleet serves the new weights with zero dropped
+        requests."""
+        rt, clock = _runtime(_state(1.0))
+        snap = ckpt.save(str(tmp_path / "m"), _state(2.0), step=1)
+        _feed(rt, clock, 8)                       # steady pre-swap load
+        rec = rt.hot_swap(snap, canary_fraction=1.0, canary_min=4,
+                          divergence_budget=100.0, lkg_after=1)
+        assert rec["rollout"] == 0 and rt.swap_active
+        submitted_before = rt.accounting()["submitted"]
+        _feed(rt, clock, 40)
+        rt.drain()
+        swap = rt.snapshot()["swap"]
+        assert swap["completed"] == 1 and swap["rollbacks"] == 0
+        assert swap["history"][0]["outcome"] == "complete"
+        # the fleet now serves the new weights
+        assert _served_value(rt) == pytest.approx(D * 2.0)
+        # canary conservation: mirrored forwards ran, but accounting
+        # counts ONLY the submitted requests — the mirror is invisible
+        mirrored = rt.metrics.registry.counter(
+            "serve/canary/mirrored/model=m").value
+        assert mirrored >= 4
+        acct = rt.accounting()
+        assert acct["submitted"] == submitted_before + 40 + 1  # + probe
+        assert acct["unaccounted"] == 0
+        assert acct["by_state"] == {"done": acct["submitted"]}
+        # the pool machine touched every replica exactly once
+        installed = [e["replica"] for e in rt.pool.events
+                     if e["kind"] == "swap_installed"]
+        assert sorted(installed) == [0, 1]
+        assert any(e["kind"] == "swap_rollout_complete"
+                   for e in rt.pool.events)
+
+    def test_lkg_promoted_after_clean_windows_and_hysteresis_gate(
+            self, tmp_path):
+        """A fully-healthy rollout promotes its snapshot into the
+        ``serve-lkg`` tier slot only after ``lkg_after`` clean decision
+        windows; ``lkg_pending`` exposes the settling window a driver
+        must respect before the next hot_swap supersedes it."""
+        rt, clock = _runtime(_state(1.0))
+        base = str(tmp_path / "m")
+        snap = ckpt.save(base, _state(2.0), step=1)
+        rt.hot_swap(snap, canary_fraction=0.0, lkg_after=2)
+        _feed(rt, clock, 4)
+        rt.drain()
+        assert not rt.swap_active and rt.lkg_pending
+        assert ckpt.tier_snapshot(base, "serve-lkg") is None
+        _feed(rt, clock, 40)                      # clean decision windows
+        rt.drain()
+        assert not rt.lkg_pending
+        assert rt.snapshot()["swap"]["lkg_promotions"] == 1
+        found = ckpt.tier_snapshot(base, "serve-lkg")
+        assert found is not None
+        tier_dir, man = found
+        assert man["meta"]["promoted_from"] == "step_1"
+        # the promoted bytes ARE the published snapshot's
+        np.testing.assert_array_equal(
+            np.asarray(ckpt.load(tier_dir, verify=True)["w"]),
+            _state(2.0)["w"])
+
+    def test_canary_trip_rolls_back_before_any_replica_drains(
+            self, tmp_path):
+        """A poisoned publish trips the canary divergence SLO during the
+        mirror stage — the rollout rolls back EXACTLY once and no
+        replica ever installed (or served) the poisoned weights."""
+        rt, clock = _runtime(_state(1.0))
+        snap = ckpt.save(str(tmp_path / "m"), _state(500.0), step=1)
+        rt.hot_swap(snap, canary_fraction=1.0, canary_min=64,
+                    divergence_budget=100.0)
+        _feed(rt, clock, 24)
+        rt.drain()
+        swap = rt.snapshot()["swap"]
+        assert swap["trips"] == 1 and swap["rollbacks"] == 1
+        assert swap["completed"] == 0
+        assert swap["history"][0]["outcome"] == "rolled_back"
+        assert swap["history"][0]["reason"].startswith(
+            "canary_trip: canary-divergence/model=m")
+        # tripped in the canary stage: the pool machine never started,
+        # so there is nothing to revert and no drain ever happened
+        assert not any(e["kind"].startswith("swap_")
+                       for e in rt.pool.events)
+        assert _served_value(rt) == pytest.approx(D * 1.0)
+        assert rt.accounting()["unaccounted"] == 0
+        # the rollback latch is exactly-once: a second trigger (a canary
+        # trip racing a mid-rollout anomaly) is a no-op
+        rt._swap_rollback("again")
+        assert rt.snapshot()["swap"]["rollbacks"] == 1
+        assert not rt.lkg_pending      # a rolled-back swap never promotes
+
+    def test_mid_rollout_rollback_reinstalls_stashed_weights(
+            self, tmp_path):
+        """A rollback AFTER replicas were already swapped reinstalls
+        their stashed (still-warm) old tier stacks — the fleet serves
+        the previous weights again, exactly once."""
+        rt, clock = _runtime(_state(1.0), n_replicas=3)
+        snap = ckpt.save(str(tmp_path / "m"), _state(2.0), step=1)
+        rt.hot_swap(snap, canary_fraction=0.0)    # straight to rolling
+        # step the machine until at least one replica runs new weights
+        for _ in range(50):
+            _feed(rt, clock, 1)
+            if any(e["kind"] == "swap_installed" for e in rt.pool.events):
+                break
+        assert any(e["kind"] == "swap_installed" for e in rt.pool.events)
+        assert rt.swap_active
+        rt._swap_rollback("mid_rollout_anomaly: test")
+        assert not rt.pool.rollout_active
+        swap = rt.snapshot()["swap"]
+        assert swap["rollbacks"] == 1 and swap["completed"] == 0
+        assert swap["history"][0]["outcome"] == "rolled_back"
+        # every replica — swapped and not-yet-swapped — serves v1 again
+        for _ in range(6):
+            assert _served_value(rt) == pytest.approx(D * 1.0)
+        rt._swap_rollback("again")                 # latch: no double revert
+        assert rt.snapshot()["swap"]["rollbacks"] == 1
+
+    def test_corrupt_publish_rejected_before_any_drain(self, tmp_path):
+        """A truncated/corrupt publish must never start draining
+        replicas: hot_swap raises on manifest verification and the
+        runtime records no rollout at all."""
+        rt, clock = _runtime(_state(1.0))
+        snap = ckpt.save(str(tmp_path / "m"), _state(2.0), step=1)
+        man = ckpt.verify_snapshot(snap)
+        rel = max(man["files"], key=lambda r: man["files"][r]["size"])
+        full = os.path.join(snap, rel)
+        data = bytearray(open(full, "rb").read())
+        data[-1] ^= 0xFF               # same size, different content
+        open(full, "wb").write(bytes(data))
+        with pytest.raises(CheckpointCorrupt):
+            rt.hot_swap(snap)
+        assert not rt.swap_active and not rt.pool.rollout_active
+        assert "swap" not in rt.snapshot()        # no rollout ever began
+        _feed(rt, clock, 8)
+        rt.drain()
+        assert _served_value(rt) == pytest.approx(D * 1.0)
+
+    def test_one_rollout_at_a_time(self, tmp_path):
+        rt, clock = _runtime(_state(1.0))
+        base = str(tmp_path / "m")
+        s1 = ckpt.save(base, _state(2.0), step=1)
+        s2 = ckpt.save(base, _state(3.0), step=2)
+        rt.hot_swap(s1, canary_fraction=1.0, canary_min=1000,
+                    divergence_budget=100.0)
+        with pytest.raises(RuntimeError, match="still in progress"):
+            rt.hot_swap(s2)
+
+    def test_missing_weights_to_tiers_rejected(self, tmp_path):
+        cfg = ModelConfig(name="bare", tiers=_tiers(_state(1.0)),
+                          length_key=None)
+        rt = ServingRuntime(models=[cfg], n_replicas=1,
+                            clock=VirtualClock(),
+                            service_time=lambda m, e, n, t: 0.005)
+        snap = ckpt.save(str(tmp_path / "m"), _state(2.0), step=1)
+        with pytest.raises(ValueError, match="weights_to_tiers"):
+            rt.hot_swap(snap, model="bare")
+
+
+class TestSwapUnderChaosAndResize:
+    def test_mid_swap_replica_crash_resumes_rollout_exactly_once(
+            self, tmp_path):
+        """A replica crash DURING the rollout (parallel service model):
+        the crashed batch fails over through the ordinary exactly-once
+        latch, the fenced replica restarts and is swapped on its next
+        turn, and the rollout still completes — no request lost, no
+        double dispatch."""
+        monkey = ChaosMonkey([])
+        rt, clock = _runtime(_state(1.0), n_replicas=3,
+                             parallel_replicas=True,
+                             service_time=lambda m, e, n, t: 0.01,
+                             fence_budget_s=0.5, restart_s=0.5,
+                             chaos=monkey)
+        snap = ckpt.save(str(tmp_path / "m"), _state(2.0), step=1)
+        _feed(rt, clock, 8, dt=0.02)
+        rt.hot_swap(snap, canary_fraction=0.0)
+        sw = rt.pool._swap
+        assert sw is not None and sw["pending"]
+        victim = sw["pending"][-1]     # an unswapped, non-draining rid
+        monkey.arm(FaultSpec("replica_crash", rt._dispatch_idx + 1,
+                             batches=200, detail={"replica": victim}))
+        _feed(rt, clock, 80, dt=0.02)
+        _settle(rt, clock)
+        fences = [e for e in rt.pool.events
+                  if e["kind"] == "replica_fenced"]
+        assert any(e["replica"] == victim for e in fences)
+        fails = [e for e in rt.pool.events if e["kind"] == "failover"]
+        assert len(fails) >= 1
+        swap = rt.snapshot()["swap"]
+        assert swap["completed"] == 1 and swap["rollbacks"] == 0
+        # the fenced replica was still swapped (resumed, not skipped)
+        installed = sorted(e["replica"] for e in rt.pool.events
+                           if e["kind"] == "swap_installed")
+        assert installed == [0, 1, 2]
+        acct = rt.accounting()
+        assert acct["unaccounted"] == 0
+        assert acct["by_state"].get("failed", 0) == 0
+        # exactly-once: nothing dispatched more than twice
+        assert all(r.attempts <= 2 for r in rt.requests)
+        assert any(r.attempts == 2 for r in rt.requests)
+        assert _served_value(rt) == pytest.approx(D * 2.0)
+
+    def test_resize_interleaves_with_rollout(self, tmp_path):
+        """Growth mid-rollout joins with the NEW weights already
+        installed (never serving the retiring checkpoint), and a shrink
+        that retires a not-yet-swapped replica just drops it from the
+        pending order — the rollout still converges and every surviving
+        replica serves the new weights."""
+        rt, clock = _runtime(_state(1.0), n_replicas=3)
+        snap = ckpt.save(str(tmp_path / "m"), _state(2.0), step=1)
+        rt.hot_swap(snap, canary_fraction=0.0)
+        sw = rt.pool._swap
+        assert sw is not None and sw["pending"]
+        # hold the remaining victims (the runtime's session-pin deferral
+        # knob — the next pump re-derives it) so the rollout is still in
+        # flight while we resize around it
+        rt.pool.swap_defer = set(sw["pending"])
+        pending = list(sw["pending"])
+        # grow: the new replica must come up on the NEW weights
+        actions = rt.pool.resize(4)
+        assert actions["grown"] == [3]
+        grown_installs = [e for e in rt.pool.events
+                          if e["kind"] == "swap_installed"
+                          and e.get("grown")]
+        assert [e["replica"] for e in grown_installs] == [3]
+        assert rt.pool.rollout_active
+        # shrink: retire a replica still PENDING its swap — the machine
+        # must skip it, not wait on it forever
+        retired = pending[-1]
+        keep = [r.rid for r in rt.pool.replicas if r.rid != retired]
+        rt.pool.resize(3, protected=keep)     # 4 alive -> drain one
+        _feed(rt, clock, 40)                  # pump lifts the deferral
+        rt.drain()
+        rt.pump(force=True)                   # final completion tick
+        swap = rt.snapshot()["swap"]
+        assert swap["completed"] == 1
+        assert retired not in [r.rid for r in rt.pool.replicas]
+        # everyone left serves the new weights
+        for _ in range(6):
+            assert _served_value(rt) == pytest.approx(D * 2.0)
+        assert rt.accounting()["unaccounted"] == 0
+
+
+class TestSessionsSwapLast:
+    def test_session_pinned_replica_swapped_after_session_closes(
+            self, tmp_path):
+        """A replica pinned by an open streaming session is queued LAST
+        and additionally deferred until the session finishes — its
+        carry state is never destroyed mid-stream — then the rollout
+        resumes and completes."""
+        stores = []
+
+        def factory(rid):
+            store = {}
+            stores.append((rid, store))
+
+            def forward(batch):
+                out = []
+                for sid in batch["session"]:
+                    sid = int(sid)
+                    if sid < 0:
+                        out.append(-1)
+                        continue
+                    store[sid] = store.get(sid, 0) + 1
+                    out.append(store[sid])
+                return np.asarray(out)
+
+            return [ServingTier("stream", forward,
+                                evict_session=lambda s: store.pop(s, None))]
+
+        stream_cfg = ModelConfig(name="stream", streaming=True,
+                                 tiers=factory(-1), tier_factory=factory,
+                                 length_key=None, chunk_deadline_s=2.0)
+        clock = VirtualClock()
+        rt = ServingRuntime(models=[_config(_state(1.0)), stream_cfg],
+                            n_replicas=2, clock=clock, queue_capacity=64,
+                            max_batch=4,
+                            service_time=lambda m, e, n, t: 0.005,
+                            slo_params=dict(time_scale=0.01))
+        sid = rt.open_session("stream")
+        pinned = rt._sessions[sid]["replica"]
+        other = 1 - pinned
+        rt.submit_chunk(sid, {"input": np.ones((1, D), np.float32)})
+        rt.pump(force=True)
+        snap = ckpt.save(str(tmp_path / "m"), _state(2.0), step=1)
+        rt.hot_swap(snap, model="m", canary_fraction=0.0)
+        started = [e for e in rt.pool.events
+                   if e["kind"] == "swap_rollout_started"]
+        assert started[0]["order"] == [other, pinned]
+        _feed(rt, clock, 12)
+        rt.drain()
+        # the un-pinned replica swapped; the pinned one is deferred
+        # while the session stays open — the rollout WAITS
+        assert rt.pool.rollout_active
+        installed = [e["replica"] for e in rt.pool.events
+                     if e["kind"] == "swap_installed"]
+        assert installed == [other]
+        # session is still alive and consistent mid-rollout
+        r = rt.submit_chunk(sid, {"input": np.ones((1, D), np.float32)})
+        rt.drain()
+        assert int(np.asarray(r.result)) == 2
+        # close the session: the deferral lifts, the pinned replica
+        # drains and the rollout completes
+        rt.submit_chunk(sid, {"input": np.ones((1, D), np.float32)},
+                        final=True)
+        _feed(rt, clock, 12)
+        rt.drain()
+        installed = [e["replica"] for e in rt.pool.events
+                     if e["kind"] == "swap_installed"]
+        assert installed == [other, pinned]
+        rt.pump(force=True)               # completion tick after rejoin
+        assert rt.snapshot()["swap"]["completed"] == 1
+        assert rt.accounting()["unaccounted"] == 0

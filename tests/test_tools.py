@@ -402,6 +402,103 @@ class TestServeFleetDrill:
         assert "run_metadata" in report
 
 
+class TestLiveSwapDrill:
+    """tools/live_swap_drill.py (ISSUE 18): the hot-swap + canary +
+    rollback day under chaos, and the committed LIVE_SWAP_r01.json
+    artifact's claims.  The committed artifact pins the banked run in
+    tier-1; the live smoke re-executes the whole day and rides the
+    slow lane (the TestBenchScalingDrill precedent)."""
+
+    @pytest.mark.slow
+    def test_cli_smoke_drill_mechanics_and_conservation(self, tmp_path):
+        """One smoke execution through the CLI covers the drill
+        mechanics: rollouts complete under live traffic, the poisoned
+        canary trips and rolls back, chaos fires mid-rollout, sessions
+        replay exactly, and nothing is lost."""
+        import json
+
+        import tools.live_swap_drill as lsd
+
+        out = tmp_path / "LIVE_SWAP_smoke.json"
+        rc = lsd.main(["--smoke", "--out", str(out), "--seed", "0"])
+        assert rc == 0
+        report = json.loads(out.read_text())
+        assert report["verdict"] == "PASS"
+        assert report["checks"]["ok"], report["checks"]
+        s = report["scenario"]
+        # the hard invariants, re-asserted explicitly
+        assert s["accounting"]["unaccounted"] == 0
+        assert s["failed"] == 0 and s["shed_total"] == 0
+        assert s["swap"]["completed"] >= 3
+        assert s["swap"]["trips"] == 1 and s["swap"]["rollbacks"] == 1
+        assert s["swap"]["poison_reverted_replicas"] == []
+        assert s["swap"]["lkg_promotions"] >= 1
+        assert s["sessions"]["transcripts_exact"] is True
+        assert s["chaos"]["failovers"] >= 2
+        assert s["conservation"]["ok"] is True
+        assert s["replay"]["replay_identical"] is True
+        assert "run_metadata" in report
+
+    def test_committed_live_swap_artifact_banks_the_claims(self):
+        """The committed full-scale artifact's own claims (strict — the
+        smoke relaxations never apply): a 48k-request day, >= 3
+        completed hot-swaps under live traffic with zero dropped
+        requests, the one poisoned publish tripped the canary and
+        rolled back with zero poisoned outputs served, serve-LKG
+        promoted, chaos mid-rollout failed over and the rollout still
+        completed, session transcripts exact, spans conserved, and the
+        whole day byte-identical on replay."""
+        import json
+
+        from tools.check_artifacts import LEGACY, PATTERN, REQUIRED_KEYS
+
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "LIVE_SWAP_r01.json")
+        report = json.load(open(path))
+        assert report["verdict"] == "PASS" and report["checks"]["ok"]
+        assert report["smoke"] is False
+        assert report["config"]["n_requests"] >= 45_000
+        s = report["scenario"]
+        acct = s["accounting"]
+        assert acct["unaccounted"] == 0
+        assert acct["by_state"].get("done", 0) == acct["submitted"]
+        assert s["failed"] == 0 and s["shed_total"] == 0
+        # >= 3 completed rollouts, exactly one poisoned trip+rollback
+        sw = s["swap"]
+        assert sw["completed"] >= 3
+        assert sw["trips"] == 1 and sw["rollbacks"] == 1
+        rolled = [h for h in sw["history"]
+                  if h["outcome"] == "rolled_back"]
+        assert len(rolled) == 1
+        assert "canary_trip" in rolled[0]["reason"]
+        assert sw["poison_reverted_replicas"] == []
+        # serve-LKG promoted from the clean rollouts
+        assert sw["lkg_promotions"] >= 1
+        assert "fraud" in s["serve_lkg_tiers"]
+        # session-pinned replicas swapped last, transcripts exact
+        assert s["sessions"]["transcripts_exact"] is True
+        assert s["sessions"]["failed"] == 0
+        assert any(v["pinned"] for v in sw["rollout_orders"].values())
+        # chaos mid-rollout: both kinds fired, batches failed over,
+        # and that rollout still completed
+        assert set(s["chaos"]["fired"]) >= {"replica_crash",
+                                            "slow_forward"}
+        assert s["chaos"]["failovers"] >= 2
+        # swap lifecycle in the flight recording + span conservation
+        assert {"swap_started", "swap_rolling", "swap_complete",
+                "canary_trip", "swap_rollback",
+                "swap_lkg_promoted"} <= set(sw["note_kinds"])
+        assert s["conservation"]["ok"] is True
+        assert s["recording"]["dropped"] == 0
+        # replay discipline (the OBS_r02 standard)
+        assert s["replay"]["replay_identical"] is True
+        # governed by the artifact lint as STAMPED, not grandfathered
+        assert PATTERN.match("LIVE_SWAP_r01.json")
+        assert "LIVE_SWAP_r01.json" not in LEGACY
+        meta = report["run_metadata"]
+        assert all(k in meta for k in REQUIRED_KEYS)
+
+
 class TestObsDrillHelpers:
     """Fast pieces of tools/obs_drill.py (the committed OBS_r01.json is
     the full-size execution: drill-scale flight recording + replay hash

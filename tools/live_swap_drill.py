@@ -1,0 +1,734 @@
+"""The live-weights drill: zero-downtime checkpoint hot-swap with
+canary + LKG rollback, chaos-tested under the fleet service model.
+
+ISSUE 18's banked artifact (``LIVE_SWAP_r01.json``): a trainer keeps
+TRAINING two tiny-but-real model families (fraud — a dense head; rec —
+a DedupEmbed lookup tower) and publishing sha256-manifested snapshots
+while the SAME process serves them on a ``ServingRuntime`` (parallel
+service model, 4 replicas) under a seeded diurnal arrival trace plus
+StreamingDS2 voice sessions.  A :class:`~analytics_zoo_tpu.parallel.
+checkpoint.CheckpointWatcher` per family turns each publish into
+``ServingRuntime.hot_swap``:
+
+- **three healthy rollouts** (fraud r1, rec r1, fraud r2): seeded
+  canary mirroring → one-replica-at-a-time drain/install/re-warm with
+  session-pinned replicas swapped LAST — live sessions finish their
+  utterances on the old weights with EXACT transcripts — and the
+  fully-healthy rollouts promote their snapshots into the
+  ``serve-lkg`` checkpoint tier (PR-3's hysteresis, serving twin);
+- **one poisoned publish**: the fourth snapshot carries noise-blasted
+  weights; the canary's divergence SLO trips within a few mirrored
+  batches and the stage rolls back EXACTLY once — zero replicas ever
+  served the poison (``reverted == []``), the flight recorder banks
+  the decision;
+- **chaos mid-rollout**: while rollout 2 is draining, a replica crash
+  and a wedged (fence-budget-exceeding) slow forward are armed against
+  healthy non-pinned replicas — each victim batch rides the exactly-
+  once redispatch latch, the fenced replicas restart and the rollout
+  RESUMES to completion.  ``accounting()`` conserves every request:
+  0 failed, 0 shed, 0 unaccounted.
+
+Determinism: virtual time, seeded trace/training/noise, checkpoints in
+a per-seed scratch dir wiped per run; every scenario runs TWICE and the
+artifact records the byte-identical replay (summary digest AND the full
+flight-recording digest).  Request spans thread through the parallel
+dispatch path, so ``span_conservation`` reconciles the recording
+against ``accounting()`` and the summary attributes the swap-induced
+latency tail (in-rollout vs steady-state p99).
+
+Usage::
+
+    python tools/live_swap_drill.py            # full drill (~48k requests)
+    python tools/live_swap_drill.py --smoke    # CI-sized (seconds)
+"""
+
+import argparse
+import hashlib
+import json
+import math
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REVISION = "r01"
+
+#: offered-load geometry (full drill; --smoke divides N_REQUESTS)
+N_REQUESTS = 48_000
+MEAN_RATE = 360.0               # req/s averaged over the trace
+DIURNAL_AMP = 0.35
+MODEL_MIX = (("fraud", 0.55), ("rec", 0.45))
+DEADLINES = {"fraud": 0.08, "rec": 0.06}
+
+#: virtual service seconds per max_batch=8 batch at tier 0
+SERVICE = {"fraud": 0.008, "rec": 0.006, "ds2-stream": 0.030}
+TIER_SPEEDS = {"fraud": (1.0, 0.8), "rec": (1.0, 0.8)}
+
+FRAUD_DIM, REC_IDS = 29, 12
+REC_VOCAB, REC_DIM = 64, 8
+
+MAX_BATCH = 8
+QUEUE_CAPACITY = 384
+DECISION_EVERY = 24
+N_REPLICAS = 4
+FENCE_BUDGET_S = 0.5
+RESTART_S = 1.0
+WEDGE_DELAY_S = 2.0             # > FENCE_BUDGET_S → detected at the fence
+
+#: hot-swap knobs
+CANARY_FRACTION = 0.3
+CANARY_MIN = 24
+DIVERGENCE_BUDGET = 2.0
+LATENCY_BUDGET_S = 2.0
+LKG_AFTER = 2
+WARM_S = 0.25
+POISON_SCALE = 5.0
+
+#: publish schedule as fractions of the trace: three train-for-real
+#: rounds and one poisoned snapshot.  Chaos is armed while the THIRD
+#: rollout (index 2) is draining replicas.
+PUBLISHES = ((0.05, "fraud", "train"), (0.30, "rec", "train"),
+             (0.55, "fraud", "train"), (0.75, "fraud", "poison"))
+CHAOS_ROLLOUT = 2
+TRAIN_STEPS, TRAIN_LR = 30, 2e-3
+
+#: streaming sessions: 4 chunks of CHUNK samples each, scheduled
+#: back-to-back so some session is live across every rollout window
+CHUNK = 5000
+SESSION_SAMPLES = 20_000
+N_SESSIONS = 16
+
+
+def service_time(model, edge, n, tier):
+    if model == "ds2-stream":
+        return SERVICE[model]
+    return SERVICE[model] * TIER_SPEEDS[model][tier]
+
+
+# ---------------------------------------------------------------------------
+# Trace synthesis (numpy, seeded, vectorized)
+# ---------------------------------------------------------------------------
+
+
+def build_trace(seed: int, n: int, day_s: float):
+    """Seeded diurnal arrival script: sorted arrival times inverse-CDF
+    sampled against a sinusoid intensity, plus the per-request model."""
+    rng = np.random.default_rng(seed)
+    k = 2048
+    t = np.linspace(0.0, day_s, k + 1)
+    rate = 1.0 + DIURNAL_AMP * np.sin(
+        2 * math.pi * (t / day_s - 0.25))
+    cum = np.concatenate([[0.0], np.cumsum(
+        (rate[1:] + rate[:-1]) * 0.5 * np.diff(t))])
+    u = np.sort(rng.random(n))
+    t_arr = np.interp(u, cum / cum[-1], t)
+    names = [m for m, _ in MODEL_MIX]
+    probs = np.asarray([p for _, p in MODEL_MIX])
+    model_idx = rng.choice(len(names), size=n, p=probs).astype(np.int8)
+    return {"t": t_arr, "model_idx": model_idx, "names": names,
+            "day_s": day_s, "n": n}
+
+
+def trace_digest(trace) -> str:
+    h = hashlib.sha256()
+    for key in ("t", "model_idx"):
+        h.update(np.ascontiguousarray(trace[key]).tobytes())
+    return h.hexdigest()
+
+
+def build_session_script(seed: int, n_sessions: int, day_s: float):
+    """The voice-session lane: ``n_sessions`` utterances of
+    ``SESSION_SAMPLES`` samples, 4 chunks each, scheduled back-to-back
+    (slight overlap) so the session lane covers the whole trace — every
+    rollout sees a pinned replica.  Returns per-session audio + the
+    time-ordered chunk schedule."""
+    rng = np.random.default_rng(seed + 17)
+    audio = {s: (rng.standard_normal(SESSION_SAMPLES) * 0.1)
+             .astype(np.float32) for s in range(n_sessions)}
+    n_chunks = SESSION_SAMPLES // CHUNK
+    gap = day_s / (n_sessions * (n_chunks - 1) + 2)
+    script = []
+    for s in range(n_sessions):
+        t0 = s * (n_chunks - 1) * gap * 0.95 + gap
+        for c in range(n_chunks):
+            script.append((t0 + c * gap, s, c, c == n_chunks - 1))
+    script.sort()
+    return audio, script
+
+
+# ---------------------------------------------------------------------------
+# The model set: swap-capable fraud + rec, streaming ds2
+# ---------------------------------------------------------------------------
+
+
+def build_model_set(seed: int):
+    """Tiny-but-real jitted families.  fraud/rec declare
+    ``weights_to_tiers`` — the hot-swap contract: (restored, placed)
+    checkpoint variables in, this family's full tier stack out, closed
+    over ONE shared eval step / quantized forward so every swap reuses
+    the same compiled programs (no swap-time recompiles).  Returns
+    (configs, trainers, models) — ``trainers[name]`` runs real jitted
+    SGD rounds on the family's published training state."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.obs.slo import model_slos
+    from analytics_zoo_tpu.ops.embedding import DedupEmbed
+    from analytics_zoo_tpu.parallel import make_eval_step
+    from analytics_zoo_tpu.pipelines.deepspeech2 import (DeepSpeech2,
+                                                         ds2_streaming_tiers)
+    from analytics_zoo_tpu.serving import ModelConfig, ServingTier
+    from analytics_zoo_tpu.utils.quantize import (make_quantized_forward,
+                                                  quantize_params)
+
+    class RecTower(nn.Module):
+        @nn.compact
+        def __call__(self, ids):
+            emb = DedupEmbed(REC_VOCAB, REC_DIM, name="embed")(ids)
+            return nn.Dense(4)(emb.mean(axis=1))
+
+    configs, trainers, models = [], {}, {}
+    for i, (name, _) in enumerate(MODEL_MIX):
+        module = RecTower() if name == "rec" else nn.Dense(4)
+        model = Model(module)
+        in_dim = REC_IDS if name == "rec" else FRAUD_DIM
+        example = (jnp.zeros((1, in_dim), jnp.int32) if name == "rec"
+                   else jnp.zeros((1, in_dim), jnp.float32))
+        model.build(seed + i, example)
+        models[name] = model
+        eval_step = make_eval_step(module)
+        qfwd = make_quantized_forward(module)
+
+        def make_tiers(variables, note, _ev=eval_step, _q=qfwd,
+                       _name=name):
+            qp = quantize_params(variables)
+
+            def fwd_fp(batch, _v=variables):
+                return np.asarray(_ev(_v, jnp.asarray(batch["input"])))
+
+            def fwd_int8(batch, _p=qp):
+                return np.asarray(_q(_p, jnp.asarray(batch["input"])))
+
+            return [
+                ServingTier("fp", fwd_fp, speed=TIER_SPEEDS[_name][0],
+                            quality_note=f"fp32 weights ({note})"),
+                ServingTier("int8", fwd_int8,
+                            speed=TIER_SPEEDS[_name][1],
+                            quality_note=f"weight-only int8 ({note})"),
+            ]
+
+        def weights_to_tiers(placed, rid, _mk=make_tiers):
+            return _mk(placed, "hot-swapped")
+
+        configs.append(ModelConfig(
+            name=name, tiers=make_tiers(model.variables, "boot"),
+            weights_to_tiers=weights_to_tiers,
+            default_deadline_s=DEADLINES[name],
+            slos=model_slos(name, miss_budget=0.25, shed_budget=0.10)))
+
+        # -- the trainer: real jitted value_and_grad SGD ------------------
+        rng = np.random.default_rng(seed + 101 + i)
+        if name == "rec":
+            x = jnp.asarray(rng.integers(0, REC_VOCAB, (256, REC_IDS)),
+                            jnp.int32)
+        else:
+            x = jnp.asarray(rng.standard_normal((256, in_dim)),
+                            jnp.float32)
+        y = jnp.asarray(rng.standard_normal((256, 4)), jnp.float32)
+
+        def loss_fn(vars_, xb, yb, _m=module):
+            return jnp.mean((_m.apply(vars_, xb) - yb) ** 2)
+
+        grad = jax.jit(jax.value_and_grad(loss_fn))
+
+        def train_round(vars_, _g=grad, _x=x, _y=y):
+            loss = None
+            for _ in range(TRAIN_STEPS):
+                loss, g = _g(vars_, _x, _y)
+                vars_ = jax.tree_util.tree_map(
+                    lambda v, d: v - TRAIN_LR * d, vars_, g)
+            return vars_, float(loss)
+
+        trainers[name] = train_round
+
+    ds2 = Model(DeepSpeech2(hidden=16, n_rnn_layers=1,
+                            bidirectional=False))
+    ds2.build(seed, jnp.zeros((1, 50, 13), jnp.float32))
+    models["ds2-stream"] = ds2
+    configs.append(ModelConfig(
+        name="ds2-stream", streaming=True,
+        tiers=ds2_streaming_tiers(ds2, chunk_frames=50),
+        tier_factory=lambda rid: ds2_streaming_tiers(ds2,
+                                                     chunk_frames=50),
+        pad_key="input", length_key="n_samples",
+        bucket_edges=[CHUNK], chunk_deadline_s=2.0))
+    return configs, trainers, models
+
+
+def poison_state(state, seed: int):
+    """Noise-blast every leaf — the 'bad publish' the canary must
+    catch before a single replica serves it."""
+    import jax
+
+    rng = np.random.default_rng(seed + 4242)
+    return jax.tree_util.tree_map(
+        lambda a: np.asarray(a) + POISON_SCALE
+        * rng.standard_normal(np.shape(a)).astype(np.asarray(a).dtype),
+        state)
+
+
+def build_payloads(seed: int):
+    rng = np.random.default_rng(seed + 7)
+    return {
+        "fraud": {"input": rng.standard_normal(FRAUD_DIM)
+                  .astype(np.float32)},
+        # Zipf-flavored repeated ids — the dedup'd lookup's habitat
+        "rec": {"input": np.asarray(
+            [1, 1, 1, 5, 5, 9, 1, 5, 23, 1, 9, 41][:REC_IDS],
+            np.int32)},
+    }
+
+
+# ---------------------------------------------------------------------------
+# One scenario run
+# ---------------------------------------------------------------------------
+
+
+def run_scenario(seed: int, smoke: bool, ckpt_base: str):
+    """One full live-swap scenario on a fresh runtime + fresh scratch
+    checkpoint dir; returns the deterministic summary dict."""
+    from analytics_zoo_tpu.obs import Observability, span_conservation
+    from analytics_zoo_tpu.parallel import checkpoint as ckpt
+    from analytics_zoo_tpu.parallel.checkpoint import CheckpointWatcher
+    from analytics_zoo_tpu.resilience.chaos import ChaosMonkey, FaultSpec
+    from analytics_zoo_tpu.serving import ServingRuntime, VirtualClock
+
+    if os.path.isdir(ckpt_base):
+        shutil.rmtree(ckpt_base)
+    dirs = {m: os.path.join(ckpt_base, m) for m in ("fraud", "rec")}
+    for d in dirs.values():
+        os.makedirs(d)
+
+    n = N_REQUESTS // (6 if smoke else 1)
+    day_s = n / MEAN_RATE
+    n_sessions = max(N_SESSIONS // (3 if smoke else 1), 4)
+    trace = build_trace(seed, n, day_s)
+    audio, session_script = build_session_script(seed, n_sessions, day_s)
+    payloads = build_payloads(seed)
+    configs, trainers, built = build_model_set(seed)
+    train_state = {m: built[m].variables for m in ("fraud", "rec")}
+
+    clock = VirtualClock()
+    monkey = ChaosMonkey([])
+    n_chunks = n_sessions * (SESSION_SAMPLES // CHUNK)
+    obs = Observability(capacity=(n + n_chunks) * 4 + 8192,
+                        dump_path=os.path.join(ckpt_base, "flight.json"))
+    rt = ServingRuntime(
+        models=configs, n_replicas=N_REPLICAS, clock=clock,
+        queue_capacity=QUEUE_CAPACITY, max_batch=MAX_BATCH,
+        service_time=service_time, decision_every=DECISION_EVERY,
+        fence_budget_s=FENCE_BUDGET_S, restart_s=RESTART_S,
+        slo_params=dict(time_scale=0.01), chaos=monkey, obs=obs,
+        shed_expired=False, retain_requests=True, parallel_replicas=True)
+    watchers = {m: CheckpointWatcher(dirs[m]) for m in dirs}
+
+    publishes = sorted(
+        (frac * day_s, m, kind, k)
+        for k, (frac, m, kind) in enumerate(PUBLISHES))
+    publishes = list(publishes)
+    steps = {m: 0 for m in dirs}
+
+    requests = []                       # every non-session Request
+    session_reqs = {s: [] for s in audio}
+    sids, pins = {}, {}
+    rollout_orders = {}                 # rollout idx -> (order, pinned)
+    chaos_armed = {}
+    losses = []
+
+    def do_publish(m, kind):
+        if kind == "train":
+            new_state, loss = trainers[m](train_state[m])
+            train_state[m] = new_state
+            steps[m] += 1
+            losses.append({"model": m, "round": steps[m],
+                           "loss": round(loss, 6)})
+            ckpt.save(dirs[m], new_state, step=steps[m])
+        else:
+            steps[m] += 1
+            ckpt.save(dirs[m], poison_state(train_state[m], seed),
+                      step=steps[m],
+                      meta={"note": "poisoned (drill)"})
+
+    def control_plane(now):
+        """The host-side swap driver, run each loop pass: publish due
+        snapshots, turn watcher polls into hot_swaps (one rollout at a
+        time), capture rollout order + pinned rids, arm chaos while the
+        CHAOS_ROLLOUT is draining."""
+        while publishes and publishes[0][0] <= now:
+            _, m, kind, _k = publishes.pop(0)
+            do_publish(m, kind)
+        # one rollout at a time, AND let a completed rollout's serve-LKG
+        # hysteresis settle before the next one supersedes the pending
+        # promotion — the discipline that actually fills the LKG tier
+        if not rt.swap_active and not rt.lkg_pending:
+            for m, w in watchers.items():
+                found = w.poll()
+                if found is not None:
+                    rt.hot_swap(found[0], model=m,
+                                canary_fraction=CANARY_FRACTION,
+                                canary_min=CANARY_MIN,
+                                divergence_budget=DIVERGENCE_BUDGET,
+                                latency_budget_s=LATENCY_BUDGET_S,
+                                canary_seed=seed, lkg_after=LKG_AFTER,
+                                warm_s=WARM_S)
+                    break
+        sw = rt.pool._swap
+        if sw is not None:
+            k = rt._swap_ctl["rollout"]
+            if k not in rollout_orders:
+                started = [e for e in rt.pool.events
+                           if e["kind"] == "swap_rollout_started"]
+                rollout_orders[k] = {
+                    "order": list(started[-1]["order"]),
+                    "pinned": sorted(rt._session_rids())}
+            if k == CHAOS_ROLLOUT and not chaos_armed:
+                excluded = set(rt._session_rids())
+                excluded.add(sw["current"])
+                victims = [r.rid for r in rt.pool.replicas
+                           if r.state == "healthy"
+                           and r.rid not in excluded]
+                if len(victims) >= 2:
+                    idx = rt._dispatch_idx
+                    monkey.arm(FaultSpec(
+                        "replica_crash", idx + 2, batches=40,
+                        detail={"replica": victims[0]}))
+                    monkey.arm(FaultSpec(
+                        "slow_forward", idx + 8, batches=40,
+                        detail={"replica": victims[1],
+                                "delay_s": WEDGE_DELAY_S}))
+                    chaos_armed.update(rollout=k, at_dispatch=idx,
+                                       crash_replica=victims[0],
+                                       wedge_replica=victims[1])
+
+    t_arr, model_idx, names = trace["t"], trace["model_idx"], trace["names"]
+    chunks = list(session_script)
+    i = 0
+    while i < n or chunks:
+        now = clock.now()
+        control_plane(now)
+        next_t = min(float(t_arr[i]) if i < n else float("inf"),
+                     float(chunks[0][0]) if chunks else float("inf"),
+                     publishes[0][0] if publishes else float("inf"))
+        if now < next_t:
+            if rt.pump() == 0:
+                ev = rt.next_event_t()
+                target = next_t if ev is None else min(ev, next_t)
+                clock.advance(max(target - now, 1e-9))
+            continue
+        while i < n and clock.now() >= t_arr[i]:
+            name = names[model_idx[i]]
+            t_sched = float(t_arr[i])
+            requests.append(rt.submit(
+                payloads[name], model=name,
+                deadline_s=max(t_sched + DEADLINES[name] - clock.now(),
+                               1e-9)))
+            i += 1
+        while chunks and clock.now() >= chunks[0][0]:
+            _, s, c, final = chunks.pop(0)
+            if c == 0:
+                sids[s] = rt.open_session("ds2-stream")
+                pins[s] = rt._sessions[sids[s]]["replica"]
+            chunk = audio[s][c * CHUNK:(c + 1) * CHUNK]
+            session_reqs[s].append(rt.submit_chunk(
+                sids[s], {"input": chunk}, length=len(chunk),
+                final=final))
+        rt.pump()
+    # drain the tail; keep the control plane ticking so the last
+    # rollout (the poisoned canary) reaches its terminal phase
+    for _ in range(200_000):
+        control_plane(clock.now())
+        if len(rt.queue) == 0 and not rt.swap_active and not publishes:
+            break
+        if clock.now() > day_s * 2 + 60:
+            break               # calibration failed; checks will say so
+        if rt.pump() == 0:
+            ev = rt.next_event_t()
+            clock.advance(max((ev - clock.now()) if ev is not None
+                              else 0.05, 1e-9))
+    rt.drain()
+    duration = max([clock.now()]
+                   + [r.busy_until for r in rt.pool.replicas])
+
+    # -- transcripts: pre/mid-swap sessions must equal the direct run --
+    from analytics_zoo_tpu.pipelines.deepspeech2 import StreamingDS2
+
+    transcripts_exact = True
+    for s, samples in audio.items():
+        direct = StreamingDS2(built["ds2-stream"], chunk_frames=50)
+        pieces = [direct.accept(samples[k:k + CHUNK])
+                  for k in range(0, SESSION_SAMPLES, CHUNK)]
+        pieces.append(direct.flush())
+        served = "".join(str(r.result) for r in session_reqs[s])
+        if served != "".join(pieces):
+            transcripts_exact = False
+
+    # -- swap-induced tail attribution over the retained requests ------
+    ev_notes = obs.recorder.events()
+    roll_windows = []
+    for e in ev_notes:
+        if e.get("kind") == "swap_rolling":
+            roll_windows.append([e["t"], None])
+        if e.get("kind") in ("swap_complete", "swap_rollback") \
+                and roll_windows and roll_windows[-1][1] is None:
+            roll_windows[-1][1] = e["t"]
+    note_kinds = sorted({e["kind"] for e in ev_notes
+                         if str(e.get("kind", "")).startswith(
+                             ("swap_", "canary_"))})
+
+    def in_roll(req):
+        t = req.completed_t
+        return any(a <= t <= (b if b is not None else duration)
+                   for a, b in roll_windows)
+
+    lat_in = sorted(r.completed_t - r.arrival_t
+                    for r in requests if r.finished and in_roll(r))
+    lat_out = sorted(r.completed_t - r.arrival_t
+                     for r in requests if r.finished and not in_roll(r))
+
+    def p99(xs):
+        return round(xs[int(0.99 * (len(xs) - 1))], 6) if xs else None
+
+    cons = span_conservation(ev_notes)
+    acct = rt.accounting()
+    snap = rt.snapshot()
+    met = snap["metrics"]
+    swap = snap.get("swap", {})
+
+    rollback_notes = [e for e in ev_notes
+                      if e.get("kind") == "swap_rollback"]
+    pinned_rollouts = {k: v for k, v in rollout_orders.items()
+                       if v["pinned"]}
+    pinned_last = bool(pinned_rollouts) and all(
+        sorted(v["order"][-len(v["pinned"]):]) == v["pinned"]
+        for v in pinned_rollouts.values())
+
+    chaos_kinds = sorted(e["kind"] for e in monkey.events)
+    failovers = [e for e in rt.pool.events if e["kind"] == "failover"]
+
+    def scrub(p):
+        return "/".join(str(p).split(os.sep)[-2:]) if p else p
+
+    history = []
+    for h in swap.get("history", []):
+        h = dict(h)
+        h["checkpoint"] = scrub(h.get("checkpoint"))
+        history.append(h)
+
+    summary = {
+        "accounting": acct,
+        "duration_s": round(duration, 6),
+        "completed": met["completed"],
+        "failed": met["failed"],
+        "shed_total": met["shed_total"],
+        "deadline_miss_rate": met["deadline_miss_rate"],
+        "redispatched_batches": met["redispatched_batches"],
+        "training": losses,
+        "swap": {
+            "rollouts": swap.get("rollouts", 0),
+            "completed": swap.get("completed", 0),
+            "rollbacks": swap.get("rollbacks", 0),
+            "trips": swap.get("trips", 0),
+            "lkg_promotions": swap.get("lkg_promotions", 0),
+            "history": history,
+            "rollout_orders": {str(k): v for k, v in
+                               sorted(rollout_orders.items())},
+            "poison_reverted_replicas": (
+                list(rollback_notes[0].get("reverted", []))
+                if rollback_notes else None),
+            "note_kinds": note_kinds,
+        },
+        "sessions": {
+            "opened": snap["sessions"]["opened"],
+            "failed": snap["sessions"]["failed"],
+            "pins": {str(s): pins[s] for s in sorted(pins)},
+            "transcripts_exact": transcripts_exact,
+        },
+        "chaos": {"armed": dict(chaos_armed), "fired": chaos_kinds,
+                  "failovers": len(failovers)},
+        "tail": {
+            "rollout_windows": [[round(a, 6),
+                                 round(b, 6) if b else None]
+                                for a, b in roll_windows],
+            "p99_in_rollout_s": p99(lat_in),
+            "p99_steady_s": p99(lat_out),
+            "requests_in_rollout": len(lat_in),
+        },
+        "conservation": {
+            "traces": cons["traces"], "spans": cons["spans"],
+            "roots_by_status": cons["roots_by_status"],
+            "violations": cons["violations"][:8], "ok": cons["ok"],
+        },
+        "recording": {
+            "events": len(ev_notes),
+            "dropped": obs.recorder.dropped,
+            "sha256": hashlib.sha256(
+                obs.dump("drill_complete").encode()).hexdigest(),
+        },
+        "serve_lkg_tiers": sorted(
+            m for m in dirs
+            if ckpt.tier_snapshot(dirs[m], "serve-lkg") is not None),
+    }
+    return summary
+
+
+def digest(summary) -> str:
+    return hashlib.sha256(json.dumps(
+        summary, sort_keys=True).encode()).hexdigest()
+
+
+def run_twice(seed, smoke, ckpt_base):
+    a = run_scenario(seed, smoke, ckpt_base)
+    b = run_scenario(seed, smoke, ckpt_base)
+    da, db = digest(a), digest(b)
+    return a, {"digest": da, "replay_identical": da == db}
+
+
+# ---------------------------------------------------------------------------
+# The drill
+# ---------------------------------------------------------------------------
+
+
+def live_swap_drill(seed: int, smoke: bool = False) -> dict:
+    ckpt_base = os.path.join(
+        tempfile.gettempdir(), f"azr_live_swap_{seed}_{os.getpid()}")
+    try:
+        s, replay = run_twice(seed, smoke, ckpt_base)
+    finally:
+        shutil.rmtree(ckpt_base, ignore_errors=True)
+
+    acct = s["accounting"]
+    total_session_chunks = (s["sessions"]["opened"]
+                            * (SESSION_SAMPLES // CHUNK))
+    sw = s["swap"]
+    checks = {
+        "zero_unaccounted": acct["unaccounted"] == 0,
+        "zero_failed_requests": s["failed"] == 0,
+        "zero_shed": s["shed_total"] == 0,
+        "all_requests_completed": (
+            acct["by_state"].get("done", 0)
+            == acct["submitted"] > 0),
+        "three_rollouts_completed": sw["completed"] >= 3,
+        "canary_tripped_once": sw["trips"] == 1,
+        "rollback_exactly_once": sw["rollbacks"] == 1,
+        "poisoned_rollout_rolled_back": any(
+            h["outcome"] == "rolled_back"
+            and "canary_trip" in str(h.get("reason"))
+            for h in sw["history"]),
+        "poison_never_served": sw["poison_reverted_replicas"] == [],
+        "serve_lkg_promoted": (sw["lkg_promotions"] >= 1
+                               and "fraud" in s["serve_lkg_tiers"]),
+        "sessions_transcripts_exact": (
+            s["sessions"]["transcripts_exact"]
+            and s["sessions"]["failed"] == 0),
+        "session_pinned_replicas_swapped_last": any(
+            v["pinned"] for v in sw["rollout_orders"].values())
+            and all(sorted(v["order"][-len(v["pinned"]):]) == v["pinned"]
+                    for v in sw["rollout_orders"].values()
+                    if v["pinned"]),
+        "chaos_crash_and_wedge_fired": (
+            "replica_crash" in s["chaos"]["fired"]
+            and "slow_forward" in s["chaos"]["fired"]),
+        "chaos_batches_failed_over": s["chaos"]["failovers"] >= 2,
+        "rollout_resumed_after_chaos": any(
+            h["rollout"] == CHAOS_ROLLOUT and h["outcome"] == "complete"
+            for h in sw["history"]),
+        "swap_events_in_flight_recording": {
+            "swap_started", "swap_rolling", "swap_complete",
+            "canary_trip", "swap_rollback",
+            "swap_lkg_promoted"} <= set(sw["note_kinds"]),
+        "span_conservation_ok": s["conservation"]["ok"],
+        "roots_reconcile_with_accounting": (
+            s["conservation"]["traces"]
+            == acct["submitted"] + total_session_chunks
+            or s["conservation"]["traces"] == acct["submitted"]),
+        "nothing_dropped_from_ring": s["recording"]["dropped"] == 0,
+        "replay_identical": replay["replay_identical"],
+    }
+    return {
+        "config": {
+            "n_requests": acct["submitted"],
+            "mean_rate_rps": MEAN_RATE, "model_mix": dict(MODEL_MIX),
+            "deadlines_s": DEADLINES, "max_batch": MAX_BATCH,
+            "n_replicas": N_REPLICAS,
+            "fence_budget_s": FENCE_BUDGET_S,
+            "restart_s": RESTART_S,
+            "canary": {"fraction": CANARY_FRACTION, "min": CANARY_MIN,
+                       "divergence_budget": DIVERGENCE_BUDGET,
+                       "latency_budget_s": LATENCY_BUDGET_S},
+            "lkg_after_windows": LKG_AFTER,
+            "poison_scale": POISON_SCALE,
+            "publish_schedule": [list(p) for p in PUBLISHES],
+            "chaos_rollout": CHAOS_ROLLOUT,
+            "sessions": {"n": s["sessions"]["opened"],
+                         "chunk_samples": CHUNK,
+                         "utterance_samples": SESSION_SAMPLES},
+        },
+        "scenario": {**s, "replay": replay},
+        "headline": {
+            "rollouts_completed": sw["completed"],
+            "rollbacks": sw["rollbacks"],
+            "requests_conserved": acct["unaccounted"] == 0,
+            "dropped_requests": s["failed"],
+            "p99_in_rollout_s": s["tail"]["p99_in_rollout_s"],
+            "p99_steady_s": s["tail"]["p99_steady_s"],
+        },
+        "checks": {"ok": all(checks.values()), **checks},
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default=f"LIVE_SWAP_{REVISION}.json")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI-sized run (~5k requests, seconds)")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from analytics_zoo_tpu.obs import run_metadata
+
+    result = live_swap_drill(args.seed, args.smoke)
+    report = {
+        "drill": "live_swap_drill",
+        "revision": REVISION,
+        "seed": args.seed,
+        "smoke": bool(args.smoke),
+        "run_metadata": run_metadata("live_swap_drill", seed=args.seed,
+                                     extra={"smoke": bool(args.smoke)}),
+        **result,
+        "verdict": "PASS" if result["checks"]["ok"] else "FAIL",
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    h = report["headline"]
+    print(f"live-swap drill: {report['verdict']} — "
+          f"{report['config']['n_requests']} requests; "
+          f"{h['rollouts_completed']} rollouts completed, "
+          f"{h['rollbacks']} rollback, "
+          f"{h['dropped_requests']} dropped; p99 "
+          f"{h['p99_steady_s']}s steady vs {h['p99_in_rollout_s']}s "
+          f"in-rollout; wrote {args.out}")
+    return 0 if report["verdict"] == "PASS" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
