@@ -561,6 +561,31 @@ def _fraud_serving(mesh) -> List[AuditProgram]:
     return _tier_targets("fraud", tiers, specs)
 
 
+def _fraud_slice_serving(mesh) -> List[AuditProgram]:
+    """ISSUE 19: the width-2 :class:`ReplicaSlice` geometry — the SAME
+    fraud tier ladder re-jitted against a 2-device sub-mesh via
+    ``SpecSet.replace_mesh``, exactly how the runtime builds a slice's
+    programs.  Auditing it pins that the slice path produces genuine
+    annotated programs (donation/sharding/collectives discipline), not
+    a degenerate single-device trace wearing a wide name."""
+    from analytics_zoo_tpu.core.module import Model
+    from analytics_zoo_tpu.models import FraudMLP
+    from analytics_zoo_tpu.parallel import pipeline_specs
+    from analytics_zoo_tpu.parallel import mesh as mesh_lib
+    from analytics_zoo_tpu.pipelines.fraud import fraud_serving_tiers
+
+    devs = list(mesh.devices.reshape(-1)[:2])
+    sub = mesh_lib.create_mesh((len(devs),),
+                               (mesh_lib.data_axis(mesh),), devices=devs)
+    module = FraudMLP(in_features=29, hidden=10, n_classes=2)
+    model = Model(module)
+    model.variables = filled(abstract_variables(
+        module, _S((1, 29), np.float32)))
+    specs = pipeline_specs("fraud", mesh=mesh).replace_mesh(sub)
+    tiers = fraud_serving_tiers(model, specs=specs)
+    return _tier_targets("fraud-slice-w2", tiers, specs)
+
+
 def _rec_serving(mesh) -> List[AuditProgram]:
     from analytics_zoo_tpu.parallel import pipeline_specs
     from analytics_zoo_tpu.pipelines.recommendation import (
@@ -664,6 +689,10 @@ def repo_audit_suite(mesh=None) -> List[AuditProgram]:
     # ISSUE 18: the hot-swapped tier stack (checkpoint-restored
     # variables → place_state → tiers) audits like the boot-time one
     targets += _guarded_tiers("fraud-swapped", _fraud_swapped_serving,
+                              mesh)
+    # ISSUE 19: serving replicas that ARE mesh slices — the width-2
+    # sub-mesh tier ladder audits alongside the full-width one
+    targets += _guarded_tiers("fraud-slice-w2", _fraud_slice_serving,
                               mesh)
     targets += _guarded_tiers("rec", _rec_serving, mesh)
     targets += _guarded_tiers("sentiment", _sentiment_serving, mesh)

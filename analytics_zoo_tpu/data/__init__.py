@@ -36,6 +36,7 @@ from analytics_zoo_tpu.data.prefetch import (PrefetchDataSet,
                                              device_prefetch,
                                              overlap_window)
 from analytics_zoo_tpu.data.parallel import (ParallelLoader,
+                                             elastic_resume_coordinates,
                                              make_input_pipeline,
                                              sample_rng,
                                              seed_rngs,

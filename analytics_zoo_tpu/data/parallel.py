@@ -850,6 +850,40 @@ def replay_batches(dataset, epoch: int, batch_indices: Sequence[int],
     return out
 
 
+def elastic_resume_coordinates(epoch: int, samples_into_epoch: int,
+                               global_batch: int):
+    """Translate a checkpoint's GLOBAL stream coordinate into loader
+    re-seek terms under a (possibly different) batch geometry.
+
+    The deterministic stream is defined over the merged global SAMPLE
+    sequence — per-sample seeds fold the global index (``seed_sample``),
+    batching is a trailing stage — so the stream itself is independent
+    of world size and worker count.  What changes across an elastic
+    resize is only how many samples each BATCH carries: a run that
+    checkpointed ``samples_into_epoch`` samples into ``epoch`` resumes
+    on any geometry by constructing the loader with
+    ``start_epoch=epoch`` and skipping ``samples_into_epoch //
+    global_batch`` whole batches of the new stream.
+
+    Returns ``(start_epoch, skip_batches)``.  Raises ``ValueError``
+    when the saved offset does not land on a batch boundary of the new
+    stream — resuming there would re-train (or silently drop) a partial
+    batch, so the geometries are incompatible (pick a global batch that
+    divides the offset, or resume at the old geometry).
+    """
+    if epoch < 0 or samples_into_epoch < 0 or global_batch < 1:
+        raise ValueError(
+            f"elastic_resume_coordinates: invalid coordinate (epoch="
+            f"{epoch}, samples={samples_into_epoch}, batch={global_batch})")
+    if samples_into_epoch % global_batch:
+        raise ValueError(
+            f"elastic resume: sample offset {samples_into_epoch} is not "
+            f"a multiple of the new global batch {global_batch} — the "
+            f"checkpoint boundary does not land on a batch boundary of "
+            f"the resumed stream")
+    return int(epoch), samples_into_epoch // global_batch
+
+
 # ---------------------------------------------------------------------------
 # Device-overlap composition
 # ---------------------------------------------------------------------------

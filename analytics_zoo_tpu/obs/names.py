@@ -110,6 +110,17 @@ CATALOG: Dict[str, str] = {
         "counter · pool-growth actuations taken by the policy loop",
     "autoscale/shrink":
         "counter · drain-then-retire shrink actuations taken",
+    "autoscale/reshape":
+        "counter · width-vs-count reshape actuations: a batch-saturated "
+        "model's tier ladder swapped onto wider mesh slices instead of "
+        "adding replicas (the B/128 occupancy-knee rationale)",
+    # -- elastic mesh (parallel.train Optimizer elastic resume) -------------
+    "elastic/restores":
+        "counter · checkpoint restores re-placed onto a different world "
+        "width than they were saved at",
+    "elastic/world_width":
+        "gauge · data-axis width the last elastic restore re-placed "
+        "onto",
     # -- SLO engine (obs.slo.SloEvaluator(registry=)) -----------------------
     "slo/fast_burn/slo=*":
         "gauge · latest fast-window burn rate per SLO (1.0 = budget "

@@ -87,6 +87,7 @@ from analytics_zoo_tpu.resilience import (
     FATAL_ERRORS,
     AnomalyPolicy,
     CheckpointCorrupt,
+    ElasticPlacementError,
     InjectedFault,
     Preempted,
     PreemptionHandler,

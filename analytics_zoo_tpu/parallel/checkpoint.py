@@ -491,6 +491,62 @@ def load(path: str, target: Any = None, step: Optional[int] = None,
         f"no intact snapshot under {base}: " + "; ".join(errors))
 
 
+def restore_elastic(path: str, target: Any, specs,
+                    step: Optional[int] = None, verify: bool = True) -> Any:
+    """Restore a checkpoint saved at ANY world size and place it under
+    ``specs`` (a :class:`~analytics_zoo_tpu.parallel.specs.SpecSet`,
+    possibly width W′ ≠ the saving run's W).
+
+    Checkpoints hold width-agnostic HOST values by construction
+    (``mesh.host_local_state`` reads the local replica of every leaf
+    before the atomic write), so elastic re-placement is exactly one
+    ``place_state`` under the new declaration: parameters replicate,
+    optimizer slots re-shard through the same path-matched rules as
+    their parameters, and the (replicated) RNG key carries over bit-
+    exactly — the per-step ``fold_in(rng, step)`` is width-invariant,
+    so the restored stream continues where the W-wide run left it.
+
+    Raises :class:`~analytics_zoo_tpu.resilience.errors.
+    ElasticPlacementError` when the restored tree does not structure-
+    match ``specs``' resolved spec tree (a model/checkpoint mismatch
+    would otherwise surface as an opaque device_put failure), and
+    propagates the same error from ``place_state`` when the mesh cannot
+    carry the declaration's axes.
+    """
+    from analytics_zoo_tpu.resilience.errors import ElasticPlacementError
+
+    try:
+        state = load(path, target=target, step=step, verify=verify)
+    except CheckpointCorrupt:
+        if target is None:
+            raise
+        # disambiguate: an orbax key/structure mismatch against `target`
+        # surfaces from load's fallback walk as CheckpointCorrupt.  If
+        # the snapshot restores RAW, the bytes are intact and the
+        # failure is a model/checkpoint mismatch — name it.
+        raw = load(path, target=None, step=step, verify=verify)
+        raise ElasticPlacementError(
+            f"restore_elastic: snapshot is intact but does not "
+            f"structure-match the target tree (snapshot top-level keys "
+            f"{sorted(raw) if isinstance(raw, dict) else type(raw)}, "
+            f"target {jax.tree_util.tree_structure(target)}) — wrong "
+            f"model for this checkpoint, not corruption")
+    spec_tree = specs.state_specs(state)
+    got = jax.tree_util.tree_structure(state)
+    want = jax.tree_util.tree_structure(spec_tree)
+    if got != want:  # pragma: no cover - state_specs maps over state
+        raise ElasticPlacementError(
+            f"restore_elastic: restored state does not structure-match "
+            f"the declared spec tree (state {got}, specs {want})")
+    if target is not None:
+        t_struct = jax.tree_util.tree_structure(target)
+        if got != t_struct:
+            raise ElasticPlacementError(
+                f"restore_elastic: restored state does not structure-"
+                f"match the target tree (state {got}, target {t_struct})")
+    return specs.place_state(state)
+
+
 def has_checkpoint(path: str) -> bool:
     """True when at least one restore candidate exists under ``path``
     (it may still fail verification — ``load`` handles fallback)."""

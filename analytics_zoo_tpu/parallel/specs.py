@@ -47,6 +47,18 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from analytics_zoo_tpu.parallel import mesh as mesh_lib
+from analytics_zoo_tpu.resilience.errors import ElasticPlacementError
+
+
+def _spec_axes(spec) -> set:
+    """Mesh-axis names one PartitionSpec (or axis sequence) references."""
+    axes = set()
+    for part in spec:
+        if part is None:
+            continue
+        for ax in (part if isinstance(part, tuple) else (part,)):
+            axes.add(ax)
+    return axes
 
 
 @dataclasses.dataclass(frozen=True)
@@ -171,6 +183,63 @@ class SpecSet:
         return (self.batch_shardings() is not None
                 and not mesh_lib.spans_processes(self.mesh))
 
+    # -- elastic resize (declaration ⊆ mesh coverage) --------------------
+    def declared_axes(self) -> frozenset:
+        """Every mesh-axis name the declaration references: the batch
+        overrides' PartitionSpecs plus the axes the state rules can
+        resolve to (probed — rule spec builders close over their axis
+        names; see ``tensor.rule_axes``)."""
+        from analytics_zoo_tpu.parallel import tensor as tensor_lib
+
+        axes = set()
+        for spec in (self.batch_overrides or {}).values():
+            axes |= _spec_axes(spec)
+        if self.rules:
+            axes |= set(tensor_lib.rule_axes(self.rules))
+        return frozenset(axes)
+
+    def missing_axes(self) -> tuple:
+        """Declared axes ``self.mesh`` does not carry, sorted.  Rule axes
+        in this set DEGRADE to replicated (sharding is an optimization);
+        override axes in it would fail placement — ``place_batch`` /
+        ``place_state`` surface that as ElasticPlacementError."""
+        return tuple(sorted(self.declared_axes()
+                            - set(self.mesh.axis_names)))
+
+    def replace_mesh(self, new_mesh: Mesh) -> "SpecSet":
+        """The elastic-resize boundary: the SAME declaration re-placed
+        onto a different mesh (a checkpoint saved at width W restores at
+        W′ by re-running ``place_state`` under the returned SpecSet —
+        params are width-agnostic host values by construction).
+
+        Raises :class:`ElasticPlacementError` when ``new_mesh`` drops an
+        axis the declaration RESOLVED on the current mesh: silently
+        degrading active tensor-parallel sharding mid-resize would
+        change program geometry without a trace.  Callers who want the
+        degradation build a fresh SpecSet via ``pipeline_specs``."""
+        active = self.declared_axes() & set(self.mesh.axis_names)
+        missing = tuple(sorted(active - set(new_mesh.axis_names)))
+        if missing:
+            raise ElasticPlacementError(
+                f"replace_mesh: new mesh axes {tuple(new_mesh.axis_names)} "
+                f"do not cover declared axes {missing} that the current "
+                f"mesh {tuple(self.mesh.axis_names)} resolves — an elastic "
+                f"re-placement must not silently drop active sharding")
+        return dataclasses.replace(self, mesh=new_mesh)
+
+    def _require_override_axes(self, site: str) -> None:
+        """Boundary check: batch-override axes absent from the mesh would
+        otherwise surface as an opaque NamedSharding failure deep inside
+        jax at device_put time."""
+        missing = tuple(sorted(
+            {ax for spec in (self.batch_overrides or {}).values()
+             for ax in _spec_axes(spec)} - set(self.mesh.axis_names)))
+        if missing:
+            raise ElasticPlacementError(
+                f"{site}: mesh axes {tuple(self.mesh.axis_names)} do not "
+                f"cover batch-override axes {missing} — the declaration "
+                f"cannot be placed on this mesh")
+
     # -- placement (the one device_put site) ----------------------------
     def place_state(self, state: Any) -> Any:
         """Host state pytree → mesh placement per the declared specs:
@@ -178,6 +247,7 @@ class SpecSet:
         ``NamedSharding`` placement with them."""
         from analytics_zoo_tpu.parallel import tensor as tensor_lib
 
+        self._require_override_axes("place_state")
         if self.rules is None:
             return mesh_lib.replicate(state, self.mesh)
         return tensor_lib.shard_tree(state, self.mesh, self.rules)
@@ -185,6 +255,7 @@ class SpecSet:
     def place_batch(self, batch: Any) -> Any:
         """Host batch pytree → mesh placement (dim 0 over ``data``,
         overrides honored, multi-host local-shard assembly)."""
+        self._require_override_axes("place_batch")
         return mesh_lib.shard_batch(batch, self.mesh,
                                     overrides=self.batch_overrides)
 

@@ -174,6 +174,27 @@ def spatial_input_spec(axis: str = MODEL_AXIS,
     return P(data_axis_name, axis, None, None)
 
 
+def rule_axes(rules: Sequence[Rule]) -> frozenset:
+    """Mesh-axis names a rule set can resolve to, discovered by probing
+    each spec builder across leaf ranks 1..4 (builders close over their
+    axis names — there is no declarative field to read).  Used by the
+    elastic boundary (``SpecSet.declared_axes``) to check whether a new
+    mesh still covers what the declaration shards."""
+    axes = set()
+    for _, spec_fn in rules:
+        for rank in (1, 2, 3, 4):
+            try:
+                resolved = spec_fn((2,) * rank)
+            except Exception:
+                continue
+            for part in resolved:
+                if part is None:
+                    continue
+                for ax in (part if isinstance(part, tuple) else (part,)):
+                    axes.add(ax)
+    return frozenset(axes)
+
+
 def partition_spec(path: str, shape: Tuple[int, ...], mesh: Mesh,
                    rules: Sequence[Rule]) -> P:
     """Resolve the first matching rule into a PartitionSpec, degrading to

@@ -575,6 +575,13 @@ class Optimizer:
         self.epoch_hook = None
         self._skip_batches = 0      # mid-epoch resume fast-forward
         self._iter_in_epoch = 0
+        # elastic resume: GLOBAL sample offset into the current epoch.
+        # Checkpoint meta records it so a restore under a DIFFERENT
+        # world size / batch geometry re-seeks the deterministic stream
+        # by sample coordinate instead of batch count (the PR-8 resume
+        # bug generalized — see docs/PARALLELISM.md "Elastic resize").
+        self._samples_in_epoch = 0
+        self._skip_samples: Optional[int] = None
         self.anomaly_policy = None
         self._anomaly = None        # AnomalySentinel, built per optimize()
         self.obs = None             # obs.Observability (set_observability)
@@ -807,11 +814,35 @@ class Optimizer:
                 loop.epoch_finished = False
                 host_iter = iter(self.dataset)
                 # mid-epoch resume: fast-forward past already-trained batches
-                # ON THE HOST — never shard/transfer data that will be dropped
+                # ON THE HOST — never shard/transfer data that will be
+                # dropped.  Elastic resume (meta carried the GLOBAL sample
+                # offset): consume by sample count so a stream re-batched
+                # under a different world size lands on the same global
+                # coordinate; the offset must land on a batch boundary of
+                # the NEW stream or the geometries are incompatible.
+                while self._skip_samples is not None and self._skip_samples > 0:
+                    b = next(host_iter, sentinel)
+                    if b is sentinel:
+                        break
+                    n_skip = _batch_size(b)
+                    if n_skip > self._skip_samples:
+                        raise ValueError(
+                            f"elastic resume: checkpointed sample offset "
+                            f"leaves {self._skip_samples} samples to skip "
+                            f"but the next batch holds {n_skip} — the "
+                            f"offset does not land on a batch boundary of "
+                            f"the resumed stream (incompatible global "
+                            f"batch geometry)")
+                    self._skip_samples -= n_skip
+                    self._samples_in_epoch += n_skip
+                    self._iter_in_epoch += 1
+                self._skip_samples = None
                 while self._skip_batches > 0:
-                    if next(host_iter, sentinel) is sentinel:
+                    b = next(host_iter, sentinel)
+                    if b is sentinel:
                         break
                     self._skip_batches -= 1
+                    self._samples_in_epoch += _batch_size(b)
                     self._iter_in_epoch += 1
                 # close_source: the prefetch worker thread closes
                 # host_iter itself on cancel/end — a consumer-side close
@@ -882,6 +913,7 @@ class Optimizer:
                             raise
                         loop.iteration += 1
                         self._iter_in_epoch += 1
+                        self._samples_in_epoch += n
                         records += n
                         # keep the loss as a device array — only force a host
                         # sync when something host-side actually reads it
@@ -948,6 +980,7 @@ class Optimizer:
                 loop.epoch += 1
                 loop.epoch_finished = True
                 self._iter_in_epoch = 0
+                self._samples_in_epoch = 0
                 loop.loss = float(loop.loss)
                 dt = self._now() - t_epoch
                 logger.info("Epoch %d done: %d records in %.1fs (%.1f records/s), loss %.4f",
@@ -1227,10 +1260,12 @@ class Optimizer:
         done = object()
         skipped = 0
         for _ in range(max(n, 0)):
-            if next(epoch_iter, done) is done:
+            b = next(epoch_iter, done)
+            if b is done:
                 break
             skipped += 1
             self._iter_in_epoch += 1
+            self._samples_in_epoch += _batch_size(b)
         if skipped:
             logger.warning("anomaly sentinel: re-sought stream past %d "
                            "batch(es) after rollback", skipped)
@@ -1286,6 +1321,8 @@ class Optimizer:
             self.checkpoint_path, state, tier="lkg",
             meta={"epoch": loop.epoch, "iteration": loop.iteration,
                   "iter_in_epoch": self._iter_in_epoch,
+                  "samples_in_epoch": self._samples_in_epoch,
+                  "world_width": self.specs.data_axis_size,
                   "health_word": 0,
                   "optim": self.optim.state_dict()})
         self._anomaly.note_promoted(step=loop.iteration,
@@ -1339,6 +1376,8 @@ class Optimizer:
                       keep_last=self.checkpoint_keep_last,
                       meta={"epoch": loop.epoch, "iteration": loop.iteration,
                             "iter_in_epoch": self._iter_in_epoch,
+                            "samples_in_epoch": self._samples_in_epoch,
+                            "world_width": self.specs.data_axis_size,
                             "optim": self.optim.state_dict()})
         if self.obs is not None:
             self.obs.registry.histogram("checkpoint/save_s").observe(
@@ -1348,7 +1387,29 @@ class Optimizer:
     def _apply_resume_meta(self, meta, loop: TrainingState, state) -> None:
         loop.epoch = int(meta.get("epoch", 0))
         loop.iteration = int(meta.get("iteration", int(state.step)))
-        self._skip_batches = int(meta.get("iter_in_epoch", 0))
+        if meta.get("samples_in_epoch") is not None:
+            # sample-coordinate resume (elastic-capable): the skip loop
+            # consumes batches until the GLOBAL sample offset is reached,
+            # valid under any world size whose stream re-batches the same
+            # merged sample sequence.  Same-geometry resumes consume
+            # exactly iter_in_epoch batches — bit-identical to the
+            # legacy batch-count path.
+            self._skip_samples = int(meta["samples_in_epoch"])
+            self._skip_batches = 0
+        else:
+            self._skip_batches = int(meta.get("iter_in_epoch", 0))
+        saved_width = meta.get("world_width")
+        if (saved_width is not None
+                and int(saved_width) != self.specs.data_axis_size):
+            logger.info(
+                "elastic resume: checkpoint saved at world width %d, "
+                "re-placing at width %d (sample offset %s)",
+                int(saved_width), self.specs.data_axis_size,
+                meta.get("samples_in_epoch"))
+            if self.obs is not None:
+                self.obs.registry.counter("elastic/restores").inc()
+                self.obs.registry.gauge("elastic/world_width").set(
+                    float(self.specs.data_axis_size))
         self.optim.load_state_dict(meta.get("optim", {}) or {})
 
     def _try_resume(self, base: str, state: TrainState, loop: TrainingState):
@@ -1391,9 +1452,14 @@ class Optimizer:
                     self._apply_resume_meta(json.load(f), loop, state)
             else:
                 loop.iteration = int(state.step)
-        logger.info("resumed from %s at epoch %d, iteration %d "
-                    "(skipping %d in-epoch batches)",
-                    base, loop.epoch, loop.iteration, self._skip_batches)
+        if self._skip_samples is not None:
+            logger.info("resumed from %s at epoch %d, iteration %d "
+                        "(re-seeking %d in-epoch samples)",
+                        base, loop.epoch, loop.iteration, self._skip_samples)
+        else:
+            logger.info("resumed from %s at epoch %d, iteration %d "
+                        "(skipping %d in-epoch batches)",
+                        base, loop.epoch, loop.iteration, self._skip_batches)
         return state, loop
 
 

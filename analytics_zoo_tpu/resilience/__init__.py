@@ -22,6 +22,7 @@ itself.  The pieces (see docs/RESILIENCE.md):
 from analytics_zoo_tpu.resilience.errors import (
     FATAL_ERRORS,
     CheckpointCorrupt,
+    ElasticPlacementError,
     InjectedFault,
     Preempted,
     PrefetchWorkerDied,

@@ -118,6 +118,18 @@ class ReplicaWedged(RuntimeError):
     handled internally by ``serving.replica.ReplicaPool``."""
 
 
+class ElasticPlacementError(ValueError):
+    """An elastic re-placement asked for a mesh that cannot carry the
+    declared sharding: the new mesh's axis names do not cover every axis
+    the :class:`~analytics_zoo_tpu.parallel.specs.SpecSet` declaration
+    references (rules, batch overrides, or the data axis).  Raised at
+    the substrate boundary — ``SpecSet.replace_mesh`` / ``place_state``
+    / ``place_batch`` — with the missing axes listed, instead of the
+    opaque NamedSharding failure jax raises deep inside ``device_put``.
+    Fatal: a declaration/mesh mismatch is a configuration error; a
+    restart onto the same mesh re-creates it."""
+
+
 #: Explicit classification registries.  EVERY exception class defined in
 #: this module must appear in exactly one of the two tuples below — the
 #: taxonomy completeness test (tests/test_anomaly.py) enforces it, so a
@@ -139,6 +151,7 @@ FATAL_ERRORS: Tuple[Type[BaseException], ...] = (
     CheckpointCorrupt,
     ShardReadError,
     TrainingDiverged,
+    ElasticPlacementError,
 )
 
 

@@ -37,8 +37,10 @@ Drill: ``python tools/serve_drill.py`` (committed artifact
 load"; failure semantics in docs/RESILIENCE.md.
 """
 
-from analytics_zoo_tpu.serving.autoscale import (Autoscaler,
-                                                 AutoscalePolicy)
+from analytics_zoo_tpu.serving.autoscale import (OCCUPANCY_KNEE,
+                                                 Autoscaler,
+                                                 AutoscalePolicy,
+                                                 Reshape)
 from analytics_zoo_tpu.serving.batcher import (FIXED, AssembledBatch,
                                                DeadlineBatcher, ModelPlan)
 from analytics_zoo_tpu.serving.clock import (Clock, MonotonicClock,
@@ -46,7 +48,8 @@ from analytics_zoo_tpu.serving.clock import (Clock, MonotonicClock,
 from analytics_zoo_tpu.serving.ladder import (DegradationLadder,
                                               LadderPolicy, ServingTier)
 from analytics_zoo_tpu.serving.metrics import ServingMetrics, percentile
-from analytics_zoo_tpu.serving.replica import Replica, ReplicaPool
+from analytics_zoo_tpu.serving.replica import (Replica, ReplicaPool,
+                                               ReplicaSlice)
 from analytics_zoo_tpu.serving.request import (DEFAULT_MODEL,
                                                TERMINAL_STATES,
                                                AdmissionQueue, Request)

@@ -38,7 +38,7 @@ on top so a single noisy decision never bounces the pool.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Union
 
 #: gauge-name prefix the snapshot-only observer reads (the PR-11
 #: mirror: ``slo/fast_burn/slo=<name>`` / ``slo/slow_burn/slo=<name>``)
@@ -57,6 +57,27 @@ class AutoscalePolicy:
     new capacity needs a window to move the burn rates before the loop
     reacts again).  ``prewarm``: whether growth pre-warms compiled
     geometries before joining dispatch (the drill's A/B knob).
+
+    **Slice units** (ISSUE 19): when replicas are mesh slices
+    (:class:`~analytics_zoo_tpu.serving.replica.ReplicaSlice`),
+    ``min_replicas``/``max_replicas``/``step`` count SLICES of
+    ``slice_width`` devices each, and ``device_budget`` (when set) is
+    the hard device ceiling the bounds must fit inside — validated at
+    construction, so a width-4 grow can never exceed the budget
+    *silently*: a policy whose ``max_replicas × slice_width`` would
+    over-subscribe the fleet is rejected up front rather than clamped
+    at actuation time.
+
+    **Width-vs-count** (the reshape path): ``reshape_width`` arms the
+    alternative actuation — when growth is due AND a model's batch-fill
+    EWMA shows it batch-saturated (``fill ≥ reshape_fill``), adding
+    more width-``slice_width`` slices just splits an already-full batch
+    across more replicas, each landing further below the ≈B/128
+    occupancy knee (docs/MFU_CEILING.md) where the per-device matmuls
+    starve.  The loop then returns a :class:`Reshape` (swap that
+    model's tier ladder onto width-``reshape_width`` slices) instead of
+    a count target.  ``None`` (default) disables the path entirely —
+    the pre-ISSUE-19 decision stream is byte-identical.
     """
 
     min_replicas: int = 1
@@ -66,6 +87,10 @@ class AutoscalePolicy:
     cooldown: int = 2
     step: int = 1
     prewarm: bool = True
+    slice_width: int = 1
+    device_budget: Optional[int] = None
+    reshape_width: Optional[int] = None
+    reshape_fill: float = 0.9
 
     def __post_init__(self):
         if self.min_replicas < 1:
@@ -76,6 +101,72 @@ class AutoscalePolicy:
             raise ValueError("grow_after/shrink_after/step must be >= 1")
         if self.cooldown < 0:
             raise ValueError("cooldown must be >= 0")
+        if self.slice_width < 1:
+            raise ValueError("slice_width must be >= 1")
+        if self.device_budget is not None:
+            if self.min_replicas * self.slice_width > self.device_budget:
+                raise ValueError(
+                    f"min_replicas={self.min_replicas} slices of width "
+                    f"{self.slice_width} need "
+                    f"{self.min_replicas * self.slice_width} devices — "
+                    f"over device_budget={self.device_budget}: the "
+                    f"floor itself does not fit")
+            if self.max_replicas * self.slice_width > self.device_budget:
+                raise ValueError(
+                    f"max_replicas={self.max_replicas} × slice_width="
+                    f"{self.slice_width} = "
+                    f"{self.max_replicas * self.slice_width} devices "
+                    f"exceeds device_budget={self.device_budget} — "
+                    f"bounds are in SLICE units; set max_replicas <= "
+                    f"device_budget // slice_width so a width-"
+                    f"{self.slice_width} grow cannot over-subscribe "
+                    f"the fleet silently")
+        if not (0.0 < self.reshape_fill <= 1.0):
+            raise ValueError("reshape_fill must be in (0, 1]")
+        if self.reshape_width is not None:
+            if self.reshape_width <= self.slice_width:
+                raise ValueError(
+                    f"reshape_width={self.reshape_width} must exceed "
+                    f"slice_width={self.slice_width} — a reshape swaps "
+                    f"a saturated model onto WIDER slices")
+            if self.device_budget is not None \
+                    and self.reshape_width > self.device_budget:
+                raise ValueError(
+                    f"reshape_width={self.reshape_width} exceeds "
+                    f"device_budget={self.device_budget}: one reshaped "
+                    f"slice would not fit the fleet")
+
+    @property
+    def max_devices(self) -> int:
+        """The pool ceiling in DEVICE units — what the bounds actually
+        spend (``device_budget`` when set, else max_replicas slices)."""
+        if self.device_budget is not None:
+            return self.device_budget
+        return self.max_replicas * self.slice_width
+
+
+#: occupancy knee the width-vs-count rationale references: per-device
+#: batch ≈ B/128 is where the serving matmuls stop gaining from more
+#: batch (docs/MFU_CEILING.md) — BELOW it, width-w splits the batch w
+#: ways and each shard idles; AT it, width buys ~w× service.
+OCCUPANCY_KNEE = 128
+
+
+@dataclasses.dataclass(frozen=True)
+class Reshape:
+    """The width-grow decision (ISSUE 19): swap ``model``'s tier ladder
+    from width-``from_width`` slices onto width-``to_width`` slices
+    instead of adding more narrow replicas.  Returned by the policy
+    loop only when the model's batch-fill EWMA (``fill``) shows it
+    batch-saturated — the regime where count-growth splits a full batch
+    below the occupancy knee and buys nothing.  ``rationale`` records
+    the occupancy math the decision banked."""
+
+    model: str
+    from_width: int
+    to_width: int
+    fill: float
+    rationale: str
 
 
 class Autoscaler:
@@ -99,6 +190,7 @@ class Autoscaler:
         self.grows = 0
         self.shrinks = 0
         self.holds = 0
+        self.reshapes = 0
         #: actuation freeze (the hot-swap canary stage sets this): the
         #: loop keeps observing — streaks and cooldown advance normally —
         #: but no target is returned while held.  A canary burn must
@@ -108,13 +200,20 @@ class Autoscaler:
 
     # -- feed ----------------------------------------------------------------
     def observe_decision(self, decision, current_size: int,
-                         t: Optional[float] = None) -> Optional[int]:
+                         t: Optional[float] = None,
+                         saturation: Optional[Dict[str, float]] = None,
+                         widths: Optional[Dict[str, int]] = None,
+                         ) -> Union[int, Reshape, None]:
         """Feed one :class:`~analytics_zoo_tpu.obs.slo.SloDecision`;
         returns the new TARGET pool size when an actuation is due,
-        else ``None`` (hold)."""
+        else ``None`` (hold).  ``saturation``/``widths`` (per-model
+        batch-fill EWMA and current slice width — fed by the runtime)
+        enable the :class:`Reshape` alternative when the policy arms
+        ``reshape_width``."""
         return self.observe_hint(decision.scale_hint, current_size,
                                  t=decision.t if t is None else t,
-                                 burning=list(decision.burning))
+                                 burning=list(decision.burning),
+                                 saturation=saturation, widths=widths)
 
     def observe_registry(self, snapshot: Dict[str, Any],
                          current_size: int,
@@ -144,11 +243,23 @@ class Autoscaler:
         return self.observe_hint(hint, current_size, t=t, burning=burning)
 
     def observe_hint(self, hint: int, current_size: int, t: float = 0.0,
-                     burning: Optional[List[str]] = None) -> Optional[int]:
+                     burning: Optional[List[str]] = None,
+                     saturation: Optional[Dict[str, float]] = None,
+                     widths: Optional[Dict[str, int]] = None,
+                     ) -> Union[int, Reshape, None]:
         """The core loop on a bare ``scale_hint``.  Streak discipline:
         +1 grows the grow streak and kills the shrink streak; −1 the
         inverse; 0 (a fast-only spike, or mixed signals) kills BOTH —
-        holding is the correct response to an unconfirmed burn."""
+        holding is the correct response to an unconfirmed burn.
+
+        With ``reshape_width`` armed and ``saturation`` provided, a due
+        grow first checks width-vs-count: a model whose batch-fill EWMA
+        is at/above ``reshape_fill`` (and not yet at ``reshape_width``)
+        gets a :class:`Reshape` instead of a count target — more narrow
+        replicas would split its already-full batches below the ≈B/128
+        occupancy knee (docs/MFU_CEILING.md, :data:`OCCUPANCY_KNEE`),
+        while one wider slice serves the full batch at knee occupancy.
+        """
         self.decisions += 1
         p = self.policy
         if self.cooldown_left > 0:
@@ -187,6 +298,43 @@ class Autoscaler:
             self.cooldown_left = p.cooldown
             self._export(current_size)
             return None
+        if action == "grow" and p.reshape_width is not None \
+                and saturation:
+            # width-vs-count: the most batch-saturated model decides.
+            # At/above the fill bar, count-growth splits a full batch
+            # below the occupancy knee — swap THIS model onto wider
+            # slices instead (the runtime actuates via its reshape
+            # path; pool size is unchanged, so no count target).
+            model = max(sorted(saturation), key=lambda m: saturation[m])
+            fill = float(saturation[model])
+            from_w = int((widths or {}).get(model, p.slice_width))
+            if fill >= p.reshape_fill and from_w < p.reshape_width:
+                self.reshapes += 1
+                self.grow_streak = 0
+                self.shrink_streak = 0
+                self.cooldown_left = p.cooldown
+                rationale = (
+                    f"batch-fill EWMA {fill:.3f} >= {p.reshape_fill:.2f}"
+                    f": {model!r} is batch-saturated — +{p.step} width-"
+                    f"{from_w} replica(s) would split full batches "
+                    f"below the ~B/{OCCUPANCY_KNEE} occupancy knee "
+                    f"(docs/MFU_CEILING.md), while a width-"
+                    f"{p.reshape_width} slice serves them at knee "
+                    f"occupancy for ~{p.reshape_width / from_w:.0f}x "
+                    f"service")
+                self.events.append({
+                    "kind": "scale_reshape", "t": round(t, 6),
+                    "model": model, "from_width": from_w,
+                    "to_width": p.reshape_width,
+                    "fill": round(fill, 6),
+                    "burning": list(burning or []),
+                    "rationale": rationale})
+                if self.registry is not None:
+                    self.registry.counter("autoscale/reshape").inc()
+                self._export(current_size)
+                return Reshape(model=model, from_width=from_w,
+                               to_width=p.reshape_width, fill=fill,
+                               rationale=rationale)
         if target is not None:
             if action == "grow":
                 self.grows += 1
@@ -220,5 +368,6 @@ class Autoscaler:
             "grows": self.grows,
             "shrinks": self.shrinks,
             "holds": self.holds,
+            "reshapes": self.reshapes,
             "actions": list(self.events),
         }

@@ -95,6 +95,12 @@ class Replica:
     exists to delete.
     """
 
+    #: devices this replica occupies — a plain replica is one device;
+    #: :class:`ReplicaSlice` overrides it with its sub-mesh width.  The
+    #: pool's ``device_budget`` clamp and the autoscaler's slice-unit
+    #: bounds both reason in these units (ISSUE 19).
+    width: int = 1
+
     def __init__(self, rid: int, forward_fns, clock,
                  wedge_timeout_s: float,
                  service_hook: Optional[Callable[..., float]] = None,
@@ -277,6 +283,34 @@ class Replica:
         return False
 
 
+class ReplicaSlice(Replica):
+    """A replica that IS a mesh slice (ISSUE 19 tentpole): its tier
+    programs are jitted against a width-``w`` sub-mesh rather than a
+    single device, so one pool entry occupies ``w`` devices and serves
+    each batch with ``w``-way sharded compute.
+
+    ``specs`` is the tier ladder's
+    :class:`~analytics_zoo_tpu.parallel.specs.SpecSet` rebased onto the
+    slice's sub-mesh (``SpecSet.replace_mesh``) — the same declaration
+    the training side elastically re-places, which is what makes a
+    serving replica and a training shard the same artifact.  The
+    runtime's replica factory jits the tier forwards under
+    ``specs.mesh``; this class only carries the width (for the pool's
+    device accounting) and the specs (for audit/debug surfaces).  A
+    width-1 slice is behaviorally a plain :class:`Replica`.
+    """
+
+    def __init__(self, rid: int, forward_fns, clock,
+                 wedge_timeout_s: float, width: int = 1,
+                 specs: Optional[Any] = None, **kwargs):
+        if width < 1:
+            raise ValueError(f"slice width must be >= 1, got {width}")
+        super().__init__(rid, forward_fns, clock, wedge_timeout_s,
+                         **kwargs)
+        self.width = int(width)
+        self.specs = specs
+
+
 class ReplicaPool:
     """Round-robin dispatch over healthy replicas with fence + exactly-
     once failover, plus the resize actuator the autoscaler drives.
@@ -301,7 +335,8 @@ class ReplicaPool:
                  fence_budget_s: Optional[float] = None,
                  replica_factory: Optional[Callable[[int], Replica]] = None,
                  prewarm_keys: Optional[Sequence[GeometryKey]] = None,
-                 compile_s: float = 0.0):
+                 compile_s: float = 0.0,
+                 device_budget: Optional[int] = None):
         if not replicas:
             raise ValueError("need at least one replica")
         self.replicas = list(replicas)
@@ -313,6 +348,11 @@ class ReplicaPool:
         self.replica_factory = replica_factory
         self.prewarm_keys = tuple(prewarm_keys) if prewarm_keys else ()
         self.compile_s = float(compile_s)
+        #: hard device ceiling (ISSUE 19 satellite): replica growth is
+        #: clamped so Σ width over non-draining replicas never exceeds
+        #: it — a width-4 slice grow can't silently over-subscribe the
+        #: fleet the way a replica-count bound alone would allow.
+        self.device_budget = device_budget
         self._rr = 0
         self._rid_counter = max(r.rid for r in self.replicas) + 1
         #: active hot-swap rollout (None between rollouts) — see hot_swap
@@ -369,6 +409,14 @@ class ReplicaPool:
         restart-pending, warming) — draining replicas are already on
         their way out."""
         return sum(r.state != "draining" for r in self.replicas)
+
+    @property
+    def devices_used(self) -> int:
+        """Devices occupied by non-draining replicas — Σ ``width``, the
+        unit the ``device_budget`` clamp and the autoscaler's slice-unit
+        bounds reason in (a plain replica is width 1)."""
+        return sum(r.width for r in self.replicas
+                   if r.state != "draining")
 
     @property
     def cold_compiles(self) -> int:
@@ -459,6 +507,18 @@ class ReplicaPool:
             rid = self._rid_counter
             self._rid_counter += 1
             r = self.replica_factory(rid)
+            if self.device_budget is not None \
+                    and self.devices_used + r.width > self.device_budget:
+                # grow clamped AT THE ACTUATOR: the pool refuses to
+                # over-subscribe devices even if a policy bug asks it to
+                self._rid_counter -= 1
+                self._event({"kind": "resize_budget_clamped",
+                             "t": round(self.clock.now(), 6),
+                             "requested": int(n), "size": self.size,
+                             "devices_used": self.devices_used,
+                             "width": r.width,
+                             "device_budget": self.device_budget})
+                break
             r.compile_s = self.compile_s
             self._adopt(r)
             now = self.clock.now()

@@ -75,7 +75,9 @@ from analytics_zoo_tpu.serving.clock import Clock, MonotonicClock
 from analytics_zoo_tpu.serving.ladder import (DegradationLadder,
                                               LadderPolicy, ServingTier)
 from analytics_zoo_tpu.serving.metrics import ServingMetrics
-from analytics_zoo_tpu.serving.replica import Replica, ReplicaPool
+from analytics_zoo_tpu.serving.autoscale import OCCUPANCY_KNEE, Reshape
+from analytics_zoo_tpu.serving.replica import (Replica, ReplicaPool,
+                                               ReplicaSlice)
 from analytics_zoo_tpu.serving.request import (DEFAULT_MODEL,
                                                AdmissionQueue, Request)
 
@@ -234,7 +236,9 @@ class ServingRuntime:
                  slo_params: Optional[Dict[str, Any]] = None,
                  weight_cap: float = 4.0,
                  retain_requests: bool = True,
-                 parallel_replicas: bool = False):
+                 parallel_replicas: bool = False,
+                 slice_width: int = 1,
+                 device_budget: Optional[int] = None):
         if models is not None:
             if tiers is not None:
                 raise ValueError("pass tiers= OR models=, not both")
@@ -316,6 +320,21 @@ class ServingRuntime:
         self.autoscaler = autoscaler
         if autoscaler is not None and autoscaler.registry is None:
             autoscaler.registry = self.metrics.registry
+        # replicas-as-mesh-slices (ISSUE 19): every pool entry occupies
+        # ``slice_width`` devices; ``device_budget`` is the pool's hard
+        # device ceiling.  ``_model_width`` tracks each model's CURRENT
+        # slice width (a reshape moves one model wider); the service
+        # model divides by the occupancy-limited width speedup, so
+        # width only pays off past the ≈B/128 knee (docs/MFU_CEILING.md)
+        if slice_width < 1:
+            raise ValueError(f"slice_width must be >= 1, got {slice_width}")
+        self.slice_width = int(slice_width)
+        self._model_width: Dict[str, int] = {
+            name: self.slice_width for name in self.models}
+        #: per-model batch-fill EWMA — the autoscaler's width-vs-count
+        #: saturation signal (0..1 of the model's batch budget)
+        self._fill_ewma: Dict[str, float] = {}
+        self._reshape_log: List[Dict[str, Any]] = []
         self.requests: List[Request] = []      # every request ever submitted
         self._rid = itertools.count()
         self._spans: Dict[int, Dict[str, Any]] = {}   # rid -> open spans
@@ -360,9 +379,17 @@ class ServingRuntime:
 
         def service_hook(batch: AssembledBatch, rid: int) -> float:
             if self._multi:
-                return service_time(batch.model, batch.edge,
-                                    batch.n_valid, batch.tier)
-            return service_time(batch.edge, batch.n_valid, batch.tier)
+                s = service_time(batch.model, batch.edge,
+                                 batch.n_valid, batch.tier)
+            else:
+                s = service_time(batch.edge, batch.n_valid, batch.tier)
+            w = self._model_width.get(batch.model, 1)
+            if w > 1:
+                # a width-w slice serves the batch w-way sharded, but
+                # only as fast as per-device occupancy allows — below
+                # the knee the shards starve and width buys nothing
+                s = s / self._width_speedup(batch.n_valid, w)
+            return s
 
         self._service_hook = service_hook if virtual else None
         self.pool = ReplicaPool(
@@ -372,7 +399,8 @@ class ServingRuntime:
             fence_budget_s=fence_budget_s,
             replica_factory=self._make_replica,
             prewarm_keys=self._geometry_plan(),
-            compile_s=compile_s)
+            compile_s=compile_s,
+            device_budget=device_budget)
         self.ladders: Dict[str, DegradationLadder] = {
             name: DegradationLadder(
                 len(cfg.tiers), cfg.ladder_policy or ladder_policy)
@@ -410,10 +438,48 @@ class ServingRuntime:
                     f"template declares {len(cfg.tiers)}")
             fwd[name] = [tier.forward for tier in t]
             tier_objs[name] = list(t)
-        replica = Replica(rid, fwd, self.clock, self.wedge_timeout_s,
-                          service_hook=self._service_hook)
+        if self.slice_width > 1:
+            # the replica IS a mesh slice (ISSUE 19): its programs are
+            # jitted against the tier SpecSet's width-w sub-mesh — the
+            # same declaration the elastic trainer re-places — and the
+            # pool accounts it as ``width`` devices
+            slice_specs = self.specs
+            if slice_specs is not None \
+                    and slice_specs.data_axis_size != self.slice_width:
+                from analytics_zoo_tpu.parallel import mesh as mesh_lib
+
+                devs = list(
+                    slice_specs.mesh.devices.reshape(-1)
+                    [: self.slice_width])
+                sub = mesh_lib.create_mesh(
+                    (self.slice_width,),
+                    (mesh_lib.data_axis(slice_specs.mesh),),
+                    devices=devs)
+                slice_specs = slice_specs.replace_mesh(sub)
+            replica = ReplicaSlice(
+                rid, fwd, self.clock, self.wedge_timeout_s,
+                width=self.slice_width, specs=slice_specs,
+                service_hook=self._service_hook)
+        else:
+            replica = Replica(rid, fwd, self.clock, self.wedge_timeout_s,
+                              service_hook=self._service_hook)
         replica.tier_objs = tier_objs
         return replica
+
+    @staticmethod
+    def _width_speedup(n_valid: int, width: int) -> float:
+        """Occupancy-limited service speedup of a width-``width`` slice
+        on a batch of ``n_valid``: each of the ``width`` shards serves
+        ``n_valid/width`` at ``min(1, (n/w)/knee)`` occupancy, so the
+        slice delivers ``w`` × that against the width-1 baseline's
+        ``min(1, n/knee)``.  Saturated (n ≥ w·knee) → exactly
+        ``width``; below the knee (n ≤ knee) → exactly 1.0 — width
+        buys NOTHING until the model is batch-saturated, which is the
+        whole width-vs-count policy (docs/MFU_CEILING.md)."""
+        n = max(float(n_valid), 1.0)
+        base = min(1.0, n / OCCUPANCY_KNEE)
+        wide = min(1.0, (n / width) / OCCUPANCY_KNEE) * width
+        return wide / base
 
     # -- telemetry -----------------------------------------------------------
     def _on_pool_event(self, ev: Dict[str, Any]) -> None:
@@ -1144,6 +1210,17 @@ class ServingRuntime:
 
         return fault
 
+    def _note_fill(self, batch: AssembledBatch) -> None:
+        """Per-model batch-fill EWMA — the autoscaler's width-vs-count
+        saturation signal: sustained fill ≈ 1.0 means the model is
+        batch-saturated and count-growth would split full batches below
+        the occupancy knee (see :meth:`_width_speedup`)."""
+        cap = max(self.batcher.model_batch(batch.model), 1)
+        fill = min(1.0, batch.n_valid / cap)
+        prev = self._fill_ewma.get(batch.model)
+        self._fill_ewma[batch.model] = (
+            fill if prev is None else 0.8 * prev + 0.2 * fill)
+
     def _dispatch(self, batch: AssembledBatch) -> None:
         self._scrub_dead_session_rows(batch)
         if self.parallel:
@@ -1153,6 +1230,7 @@ class ServingRuntime:
         self.metrics.on_batch(batch.n_valid,
                               self.batcher.model_batch(batch.model),
                               self.queue.depth)
+        self._note_fill(batch)
         model_label = batch.model if self._multi else None
         t0 = self.clock.now()
         batch_span = None
@@ -1262,6 +1340,7 @@ class ServingRuntime:
         self.metrics.on_batch(batch.n_valid,
                               self.batcher.model_batch(batch.model),
                               self.queue.depth)
+        self._note_fill(batch)
         now = self.clock.now()
         model_label = batch.model if self._multi else None
         batch_span = None
@@ -1563,10 +1642,18 @@ class ServingRuntime:
         """The autoscaler's policy loop, then the ACTUATION: a due
         target resizes the pool — growth pre-warms compiled geometries
         before the replica joins dispatch, shrink drains-then-retires
-        (session-pinned replicas protected)."""
-        target = self.autoscaler.observe_decision(decision,
-                                                  self.pool.size)
+        (session-pinned replicas protected).  A :class:`Reshape`
+        decision (the width-vs-count path, armed by
+        ``policy.reshape_width``) instead swaps the saturated model's
+        ladder onto wider slices — pool COUNT unchanged."""
+        target = self.autoscaler.observe_decision(
+            decision, self.pool.size,
+            saturation=dict(self._fill_ewma) or None,
+            widths=dict(self._model_width))
         if target is None:
+            return
+        if isinstance(target, Reshape):
+            self._do_reshape(target)
             return
         protected = self._session_rids()
         if self.pool._swap is not None \
@@ -1583,6 +1670,38 @@ class ServingRuntime:
                 target=target, grown=actions["grown"],
                 drained=actions["drained"],
                 burning=list(decision.burning))
+
+    def _do_reshape(self, decision: Reshape) -> None:
+        """Actuate a width reshape: the model's service model moves to
+        ``to_width``-way sharded slices, and every replica's warm keys
+        for that model are DROPPED — wider geometry means new compiled
+        programs, so the next dispatch per geometry pays the cold-
+        compile tax on the hot path (a reshape must not hide its
+        recompile cost the way pre-warm hides growth's)."""
+        self._model_width[decision.model] = decision.to_width
+        dropped = 0
+        for r in self.pool.replicas:
+            if r.warm_keys:
+                before = len(r.warm_keys)
+                r.warm_keys = {k for k in r.warm_keys
+                               if k[0] != decision.model}
+                dropped += before - len(r.warm_keys)
+        ev = {"kind": "autoscale_reshape", "model": decision.model,
+              "from_width": decision.from_width,
+              "to_width": decision.to_width,
+              "fill": round(decision.fill, 6),
+              "geometries_dropped": dropped,
+              "t": round(self.clock.now(), 6),
+              "rationale": decision.rationale}
+        self._reshape_log.append(ev)
+        self.pool._event(ev)
+        if self.obs is not None:
+            self.obs.recorder.note(
+                "autoscale", t=round(self.clock.now(), 6),
+                reshape=decision.model, to_width=decision.to_width,
+                fill=round(decision.fill, 6),
+                burning=list(decision.burning)
+                if hasattr(decision, "burning") else [])
 
     # -- observability -------------------------------------------------------
     def accounting(self) -> Dict[str, Any]:
@@ -1643,6 +1762,16 @@ class ServingRuntime:
             out["tiers"] = [{"name": t.name, "speed": t.speed,
                              "quality_note": t.quality_note}
                             for t in self.tiers]
+        if self.slice_width > 1 or self._reshape_log:
+            # keyed in only when replicas are slices or a reshape fired
+            # (legacy snapshots byte-identical)
+            out["slices"] = {
+                "slice_width": self.slice_width,
+                "devices_used": self.pool.devices_used,
+                "device_budget": self.pool.device_budget,
+                "model_width": dict(sorted(self._model_width.items())),
+                "reshapes": [dict(e) for e in self._reshape_log],
+            }
         if self.slo is not None:
             # keyed in only when armed, so pre-PR-11 snapshots (and the
             # banked RESILIENCE_r03/OBS_r01 replays) are byte-unchanged

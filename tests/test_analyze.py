@@ -571,6 +571,11 @@ class TestRepoClean:
         assert "rec-wd/train" in names
         assert {"rec/serve:fp", "rec/serve:int8"} <= names
         assert {"sentiment/serve:fp", "sentiment/serve:int8"} <= names
+        # ISSUE 19: the width-2 replica-slice geometry — the fraud tier
+        # ladder re-jitted against a 2-device sub-mesh via replace_mesh
+        # audits alongside the full-width programs
+        assert {"fraud-slice-w2/serve:fp",
+                "fraud-slice-w2/serve:int8"} <= names
 
     def test_serving_tiers_expose_device_programs(self):
         """Every ladder rung the factories hand the runtime must carry

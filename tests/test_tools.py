@@ -402,6 +402,116 @@ class TestServeFleetDrill:
         assert "run_metadata" in report
 
 
+class TestElasticMeshDrill:
+    """ISSUE 19: the committed ELASTIC_r01.json artifact's claims (the
+    full drill SIGTERMs a width-4 run and resumes at widths 2/4/8 in
+    fresh processes — the smoke re-execution rides the slow lane), and
+    the serving width-vs-count reshape segment in tier-1."""
+
+    def test_committed_elastic_artifact_banks_the_claims(self):
+        import json
+
+        from tools.check_artifacts import LEGACY, PATTERN, REQUIRED_KEYS
+
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "ELASTIC_r01.json")
+        report = json.load(open(path))
+        assert report["verdict"] == "PASS"
+        tr = report["training"]
+        assert tr["ok"] and all(tr["checks"].values()), tr["checks"]
+        assert tr["save_width"] == 4
+        assert sorted(tr["resume_widths"]) == [2, 4, 8]
+        # the honest bit-exactness pins: same-width resume is byte-
+        # identical (params sha256), placement preserves bytes at every
+        # width, and the loader re-seek is shard-count independent
+        assert (tr["resume"]["w4"]["params_sha256"]
+                == tr["reference"]["w4"]["params_sha256"])
+        for leg in list(tr["resume"].values()) + [tr["resume_w2_4workers"]]:
+            probe = leg["placement_probe"]
+            assert probe["raw_sha256"] == probe["placed_sha256"]
+        assert (tr["resume"]["w2"]["params_sha256"]
+                == tr["resume_w2_4workers"]["params_sha256"])
+        # cross-width: exact step completion, fp deltas at ulp scale —
+        # zero at the save width, nonzero-but-tiny across widths
+        # (XLA's per-width reduction order; see the artifact policy)
+        deltas = tr["fingerprint_delta_vs_reference"]
+        assert deltas["w4"] == 0.0
+        fp = abs(float(tr["reference"]["w4"]["fingerprint"]))
+        assert all(d <= 1e-4 * fp for d in deltas.values())
+        # the checkpoint meta carried the elastic coordinates
+        assert tr["resume"]["w2"]["resumed_from"]["world_width"] == 4
+        assert "samples_in_epoch" in tr["resume"]["w2"]["resumed_from"]
+        # serving half: at least one width-reshape, replay-identical
+        seg = report["serving_reshape_segment"]
+        assert seg["checks"]["ok"], seg["checks"]
+        reshapes = seg["summary"]["reshapes"]
+        assert len(reshapes) >= 1
+        assert reshapes[0]["to_width"] == 4
+        assert "B/128" in reshapes[0]["rationale"]
+        assert seg["summary"]["replay"]["replay_identical"] is True
+        assert (seg["summary"]["devices_used"]
+                <= seg["config"]["autoscale_policy"]["device_budget"])
+        # governed by the artifact lint as STAMPED, not grandfathered
+        assert PATTERN.match("ELASTIC_r01.json")
+        assert "ELASTIC_r01.json" not in LEGACY
+        meta = report["run_metadata"]
+        assert all(k in meta for k in REQUIRED_KEYS)
+
+    def test_reshape_segment_smoke(self):
+        """The width-vs-count segment end-to-end on the virtual clock:
+        the saturated model reshapes onto width-4 slices with the
+        occupancy rationale, later growth respects the device budget,
+        and the replay is byte-identical."""
+        from tools.serve_fleet_drill import reshape_segment
+
+        out = reshape_segment(seed=0, smoke=True)
+        assert out["checks"]["ok"], out["checks"]
+        s = out["summary"]
+        assert s["model_width_final"]["fraud"] == 4
+        assert s["reshapes"][0]["fill"] >= 0.8
+        assert s["accounting"]["unaccounted"] == 0
+
+    def test_fleet_drill_reshape_knobs_default_off(self):
+        """Byte-inertness: the default fleet drill scenarios never
+        reshape — their summaries carry NO slice keys, so the banked
+        SERVING_SCALE_r01 replay digests are untouched."""
+        from tools.serve_fleet_drill import (build_model_set, build_trace,
+                                             run_twice)
+
+        configs = build_model_set(0)
+        trace = build_trace(0, 2000, 2000 / 450.0, burst=True)
+        summary, replay = run_twice(trace, configs, autoscale=True,
+                                    n_replicas=2)
+        assert replay["replay_identical"] is True
+        assert "reshapes" not in summary
+        assert "model_width_final" not in summary
+        assert "reshapes" not in summary["autoscale"]
+
+    @pytest.mark.slow
+    def test_elastic_drill_smoke_execution(self, tmp_path):
+        """Re-execute the training half end-to-end (8 subprocess legs):
+        the same checks the committed artifact banked must hold on a
+        fresh run."""
+        import tools.bench_scaling as bs
+
+        class _Args:
+            virtual = True
+
+        def env_for(n):
+            env = dict(os.environ)
+            env["PYTHONPATH"] = bs._REPO + (
+                os.pathsep + env["PYTHONPATH"]
+                if env.get("PYTHONPATH") else "")
+            env["PALLAS_AXON_POOL_IPS"] = ""
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = \
+                f"--xla_force_host_platform_device_count={n}"
+            return env
+
+        out = bs.run_elastic_drill(_Args(), env_for)
+        assert out["ok"], out.get("checks", out.get("error"))
+
+
 class TestLiveSwapDrill:
     """tools/live_swap_drill.py (ISSUE 18): the hot-swap + canary +
     rollback day under chaos, and the committed LIVE_SWAP_r01.json

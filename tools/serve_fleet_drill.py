@@ -249,24 +249,37 @@ def build_payloads():
 
 
 def run_scenario(trace, configs, *, autoscale: bool, prewarm: bool = True,
-                 n_replicas: int = STATIC_REPLICAS):
+                 n_replicas: int = STATIC_REPLICAS,
+                 service_fn=None, deadlines=None,
+                 max_batch: int = MAX_BATCH,
+                 queue_capacity: int = QUEUE_CAPACITY,
+                 autoscale_kw=None, device_budget=None,
+                 decision_every: int = DECISION_EVERY):
     """Replay one trace against a fresh runtime; returns the summary
-    dict (deterministic — the replay check hashes it)."""
+    dict (deterministic — the replay check hashes it).  The keyword
+    overrides (``service_fn``/``deadlines``/``max_batch``/
+    ``queue_capacity``/``autoscale_kw``/``device_budget``) exist for
+    the ISSUE-19 reshape segment; every default reproduces the banked
+    SERVING_SCALE_r01 scenarios byte-identically."""
     from analytics_zoo_tpu.resilience.errors import ServerOverloaded
     from analytics_zoo_tpu.serving import (Autoscaler, AutoscalePolicy,
                                            ServingRuntime, VirtualClock)
 
+    service_fn = service_fn or service_time
+    deadlines = deadlines or DEADLINES
     clock = VirtualClock()
     scaler = None
     if autoscale:
-        scaler = Autoscaler(AutoscalePolicy(prewarm=prewarm, **AUTOSCALE))
+        scaler = Autoscaler(AutoscalePolicy(
+            prewarm=prewarm, **{**AUTOSCALE, **(autoscale_kw or {})}))
     rt = ServingRuntime(
         models=configs, n_replicas=n_replicas, clock=clock,
-        queue_capacity=QUEUE_CAPACITY, max_batch=MAX_BATCH,
-        service_time=service_time, decision_every=DECISION_EVERY,
+        queue_capacity=queue_capacity, max_batch=max_batch,
+        service_time=service_fn, decision_every=decision_every,
         autoscaler=scaler, compile_s=COMPILE_S,
         slo_params=dict(time_scale=0.01),   # fast 3 s / slow 36 s virtual
-        retain_requests=False, parallel_replicas=True)
+        retain_requests=False, parallel_replicas=True,
+        device_budget=device_budget)
 
     payloads, ds2_payloads = build_payloads()
     names = trace["names"]
@@ -302,7 +315,7 @@ def run_scenario(trace, configs, *, autoscale: bool, prewarm: bool = True,
             try:
                 rt.submit(payload, model=name, length=length,
                           deadline_s=max(
-                              t_sched + DEADLINES[name] - clock.now(),
+                              t_sched + deadlines[name] - clock.now(),
                               1e-9))
             except ServerOverloaded:
                 pass            # accounted as shed(queue_full)
@@ -370,6 +383,14 @@ def run_scenario(trace, configs, *, autoscale: bool, prewarm: bool = True,
             e for e in rt.pool.events
             if e["kind"] in ("replica_joined", "replica_prewarmed",
                              "replica_draining", "replica_retired")][:128]
+    if rt._reshape_log:
+        # keyed in only when the width-vs-count path actuated (never in
+        # the legacy scenarios — their digests stay byte-identical)
+        summary["reshapes"] = [dict(e) for e in rt._reshape_log]
+        summary["model_width_final"] = dict(
+            sorted(rt._model_width.items()))
+        summary["autoscale"]["reshapes"] = a["reshapes"]
+        summary["devices_used"] = rt.pool.devices_used
     return summary
 
 
@@ -385,6 +406,106 @@ def run_twice(trace, configs, **kw):
     b = run_scenario(trace, configs, **kw)
     da, db = digest(a), digest(b)
     return a, {"digest": da, "replay_identical": da == db}
+
+
+# ---------------------------------------------------------------------------
+# The ISSUE-19 reshape segment: width-vs-count at high per-model batch
+# ---------------------------------------------------------------------------
+
+#: the reshape segment's geometry: a fraud-heavy overload at
+#: ``max_batch=256`` so the saturated model's batches actually REACH
+#: the ≈B/128 occupancy knee (docs/MFU_CEILING.md) — at the fleet
+#: drill's max_batch=8 a width-4 slice buys exactly nothing
+#: (``_width_speedup == 1`` below the knee), which is precisely why
+#: the default drill never reshapes
+RESHAPE_N = 16_000
+RESHAPE_RATE = 4000.0           # offered req/s, ~1.3x the 2-replica cap
+RESHAPE_MAX_BATCH = 256
+RESHAPE_QUEUE = 1024
+RESHAPE_MIX = (("fraud", 0.85), ("rec", 0.15))
+RESHAPE_SERVICE = {"fraud": 0.2, "rec": 0.05}   # s per (≤256) batch
+RESHAPE_DEADLINES = {"fraud": 0.4, "rec": 0.3}
+RESHAPE_POLICY = dict(min_replicas=2, max_replicas=4, grow_after=1,
+                      shrink_after=8, cooldown=1, step=1,
+                      slice_width=1, device_budget=4,
+                      reshape_width=4, reshape_fill=0.8)
+#: big batches mean FEW batches — the segment evaluates the policy loop
+#: every 4 dispatches where the fleet drill (max_batch=8) uses 48
+RESHAPE_DECISION_EVERY = 4
+
+
+def reshape_service_time(model, edge, n, tier):
+    return RESHAPE_SERVICE[model] * TIER_SPEEDS[model][tier]
+
+
+def reshape_segment(seed: int, smoke: bool = False) -> dict:
+    """The width-vs-count segment (ISSUE 19): fraud offered ~1.3× the
+    2-replica capacity with batches that fill to 256 — its batch-fill
+    EWMA pins at ~1.0, so the FIRST due grow becomes a
+    ``scale_reshape``: the saturated model's ladder moves to width-4
+    slices (service ÷ the occupancy-limited speedup, warm geometries
+    dropped for the wider programs) instead of splitting full batches
+    across more width-1 replicas below the knee.  Later actuations may
+    still add replicas — bounded in slice units by
+    ``device_budget=4``.  Runs twice; the artifact banks that the
+    replay was byte-identical (OBS_r02 discipline)."""
+    n = RESHAPE_N // (4 if smoke else 1)
+    day_s = n / RESHAPE_RATE
+    configs = build_model_set(seed, mix=RESHAPE_MIX)
+    trace = build_trace(seed + 7, n, day_s, burst=True, mix=RESHAPE_MIX)
+    kw = dict(autoscale=True, prewarm=True,
+              n_replicas=RESHAPE_POLICY["min_replicas"],
+              service_fn=reshape_service_time,
+              deadlines=RESHAPE_DEADLINES,
+              max_batch=RESHAPE_MAX_BATCH,
+              queue_capacity=RESHAPE_QUEUE,
+              autoscale_kw=dict(RESHAPE_POLICY),
+              device_budget=RESHAPE_POLICY["device_budget"],
+              decision_every=RESHAPE_DECISION_EVERY)
+    summary, replay = run_twice(trace, configs, **kw)
+    reshapes = summary.get("reshapes", [])
+    checks = {
+        "zero_unaccounted": summary["accounting"]["unaccounted"] == 0,
+        "at_least_one_reshape": len(reshapes) >= 1,
+        "reshape_names_saturated_model": all(
+            r["fill"] >= RESHAPE_POLICY["reshape_fill"]
+            for r in reshapes),
+        "reshape_rationale_cites_occupancy_knee": all(
+            "B/128" in r["rationale"] and "MFU_CEILING" in r["rationale"]
+            for r in reshapes),
+        "reshaped_width_actuated": any(
+            summary.get("model_width_final", {}).get(r["model"])
+            == RESHAPE_POLICY["reshape_width"] for r in reshapes),
+        "device_budget_respected": (
+            summary.get("devices_used", 0)
+            <= RESHAPE_POLICY["device_budget"]),
+        "replay_identical": replay["replay_identical"],
+    }
+    return {
+        "config": {
+            "n_requests": n, "offered_rps": RESHAPE_RATE,
+            "day_s": round(day_s, 3),
+            "model_mix": {m: p for m, p in RESHAPE_MIX},
+            "max_batch": RESHAPE_MAX_BATCH,
+            "queue_capacity": RESHAPE_QUEUE,
+            "service_s_per_batch_tier0": RESHAPE_SERVICE,
+            "deadlines_s": RESHAPE_DEADLINES,
+            "autoscale_policy": dict(RESHAPE_POLICY),
+            "occupancy_knee": 128,
+            "trace_sha256": trace_digest(trace),
+        },
+        "policy": "width-vs-count: a model whose batch-fill EWMA >= "
+                  "reshape_fill at a due grow gets its tier ladder "
+                  "swapped onto width-4 slices (scale_reshape, service "
+                  "/ the occupancy-limited speedup, warm keys dropped "
+                  "for the wider programs) instead of more width-1 "
+                  "replicas — below the ~B/128 knee "
+                  "(docs/MFU_CEILING.md) count-growth splits full "
+                  "batches into starved shards; bounds stay in slice "
+                  "units against device_budget",
+        "summary": {**summary, "replay": replay},
+        "checks": {"ok": all(checks.values()), **checks},
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -509,11 +630,41 @@ def main(argv=None) -> int:
                     help="CI-sized run (~5k requests, seconds)")
     ap.add_argument("--scale", type=int, default=1,
                     help="extra divisor on the request count")
+    ap.add_argument("--reshape-segment", action="store_true",
+                    help="run ONLY the ISSUE-19 width-vs-count reshape "
+                         "segment and write its JSON to --out (the "
+                         "elastic drill embeds it)")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     from analytics_zoo_tpu.obs import run_metadata
+
+    if args.reshape_segment:
+        seg = reshape_segment(args.seed, args.smoke)
+        report = {
+            "drill": "serve_fleet_drill/reshape_segment",
+            "revision": REVISION,
+            "seed": args.seed,
+            "smoke": bool(args.smoke),
+            "run_metadata": run_metadata("serve_fleet_drill",
+                                         seed=args.seed,
+                                         extra={"smoke": bool(args.smoke),
+                                                "segment": "reshape"}),
+            **seg,
+            "verdict": "PASS" if seg["checks"]["ok"] else "FAIL",
+        }
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+        s = report["summary"]
+        print(f"reshape segment: {report['verdict']} — "
+              f"{report['config']['n_requests']} requests, "
+              f"{len(s.get('reshapes', []))} reshape(s), widths "
+              f"{s.get('model_width_final', {})}, devices "
+              f"{s.get('devices_used', '?')}/"
+              f"{RESHAPE_POLICY['device_budget']}; wrote {args.out}")
+        return 0 if report["verdict"] == "PASS" else 1
 
     result = fleet_drill(args.seed, args.smoke, args.scale)
     report = {
