@@ -238,8 +238,11 @@ class SeededRngOnly:
 
 
 #: Modules on the step/dispatch hot path: the train step factories +
-#: host loop, the serving dispatch chain, and the two pipeline modules
-#: whose serving programs feed the runtime.
+#: host loop, the serving dispatch chain, the two pipeline modules
+#: whose serving programs feed the runtime, and the device-health
+#: fingerprint programs (the parity audit's no-host-sync contract:
+#: fingerprints fold in-graph and are fetched only at the decision
+#: boundary in the host loop).
 _HOT_MODULES = frozenset({
     "parallel/train.py",
     "parallel/optim.py",
@@ -249,6 +252,7 @@ _HOT_MODULES = frozenset({
     "serving/request.py",
     "pipelines/ssd.py",
     "pipelines/deepspeech2.py",
+    "resilience/health.py",
 })
 
 

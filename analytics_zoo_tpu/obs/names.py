@@ -121,6 +121,24 @@ CATALOG: Dict[str, str] = {
     "elastic/world_width":
         "gauge · data-axis width the last elastic restore re-placed "
         "onto",
+    # -- device health (resilience.health.HealthSentinel(registry=)) --------
+    "health/audits":
+        "counter · cross-replica parity audits run (per-replica param "
+        "fingerprints compared at the decision boundary)",
+    "health/audit_divergences":
+        "counter · audits whose replica fingerprints disagreed (proven "
+        "silent data corruption)",
+    "health/shadow_checks":
+        "counter · shadow recomputes run (sampled microbatch forward "
+        "re-executed on a second device)",
+    "health/shadow_mismatches":
+        "counter · shadow recomputes disagreeing with the primary",
+    "health/straggler_flags":
+        "counter · devices flagged by the step-time EWMA hysteresis "
+        "ladder as persistent stragglers",
+    "health/quarantines":
+        "counter · devices quarantined (training eviction raised or "
+        "serving replica drained with device_budget decremented)",
     # -- SLO engine (obs.slo.SloEvaluator(registry=)) -----------------------
     "slo/fast_burn/slo=*":
         "gauge · latest fast-window burn rate per SLO (1.0 = budget "

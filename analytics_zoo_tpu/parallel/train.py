@@ -584,6 +584,10 @@ class Optimizer:
         self._skip_samples: Optional[int] = None
         self.anomaly_policy = None
         self._anomaly = None        # AnomalySentinel, built per optimize()
+        self.health_policy = None
+        self._health = None         # HealthSentinel, built per optimize()
+        self._audit_fn = None       # jitted parity audit, built lazily
+        self._shadow_fn = None      # jitted shadow forward, built lazily
         self.obs = None             # obs.Observability (set_observability)
 
     # -- fluent config (reference API names, snake_cased) ------------------
@@ -651,6 +655,26 @@ class Optimizer:
         round trip per step (health word + loss fetched together)."""
         from analytics_zoo_tpu.resilience.anomaly import AnomalyPolicy
         self.anomaly_policy = policy or AnomalyPolicy()
+        return self
+
+    def set_health_policy(self, policy=None) -> "Optimizer":
+        """Arm the device-health sentinel (``resilience.health``): every
+        ``audit_every`` steps an in-graph per-replica param fingerprint
+        (one shard_map program, no per-step cost) is fetched at the
+        decision boundary and compared — data-parallel replicas must be
+        bit-identical post-all-reduce, so a divergence proves silent
+        data corruption and the minority vote names the device; every
+        ``shadow_every`` steps the current microbatch's forward is
+        recomputed on a second device and the output fingerprints
+        compared (a third device breaks ties when available).  A named
+        suspect raises retryable ``DeviceQuarantine`` — pair with
+        ``set_anomaly_policy`` + ``set_checkpoint`` so the supervisor
+        can rebuild on the surviving devices from the LKG tier
+        (``health.evict_device`` + elastic resume); an unattributable
+        divergence raises fatal ``SdcDetected``.  Default policy audits
+        every 8 steps; all knobs default off on an un-armed Optimizer."""
+        from analytics_zoo_tpu.resilience.health import HealthPolicy
+        self.health_policy = policy or HealthPolicy(audit_every=8)
         return self
 
     def set_observability(self, obs=None) -> "Optimizer":
@@ -787,6 +811,14 @@ class Optimizer:
             # "dispatch" named honestly: async dispatch returns before
             # the device finishes (see set_observability docstring)
             step_timer = StepTimer("train/dispatch", registry=obs.registry)
+        self._health = None
+        if (self.health_policy is not None
+                and (self.health_policy.audit_every > 0
+                     or self.health_policy.shadow_every > 0)):
+            from analytics_zoo_tpu.resilience.health import HealthSentinel
+            self._health = HealthSentinel(
+                self.health_policy,
+                registry=obs.registry if obs is not None else None)
         if self.prefetch:
             from analytics_zoo_tpu.data.prefetch import device_prefetch
         # single-process, no per-key overrides: host batches go straight
@@ -952,6 +984,26 @@ class Optimizer:
                                         "training_diverged",
                                         iteration=loop.iteration)
                                     obs.dump("training_diverged")
+                                raise
+                        if self._health is not None:
+                            # parity audit / shadow recompute at their
+                            # cadences; a confirmed bad device raises
+                            # DeviceQuarantine (retryable — supervisor
+                            # rebuilds on survivors), unattributable
+                            # corruption raises fatal SdcDetected
+                            try:
+                                self._health_step(loop, state, dev_batch)
+                            except Exception as e:
+                                if (step_span is not None
+                                        and not step_span.ended):
+                                    step_span.end(
+                                        status="error",
+                                        error=f"{type(e).__name__}: {e}")
+                                if obs is not None:
+                                    obs.recorder.note(
+                                        "device_health",
+                                        iteration=loop.iteration)
+                                    obs.dump("device_health")
                                 raise
                         if step_span is not None and not step_span.ended:
                             step_span.end(status="ok")
@@ -1205,6 +1257,100 @@ class Optimizer:
             if finite:
                 loop.loss = finite[-1]
         return state
+
+    # -- device-health sentinel (resilience.health) ------------------------
+    def _health_step(self, loop: TrainingState, state: TrainState,
+                     dev_batch) -> None:
+        """Run the armed detectors at their cadences.  The audit is one
+        pre-built jitted program fetched with a single ``jax.device_get``
+        at the decision boundary (the ``_anomaly_step`` host-cost
+        contract) — steps between audits pay nothing.  Raises
+        ``DeviceQuarantine`` (named suspect, eviction budget permitting)
+        or ``SdcDetected`` (proven but unattributable corruption)."""
+        from analytics_zoo_tpu.resilience import health as health_lib
+        from analytics_zoo_tpu.resilience.errors import (DeviceQuarantine,
+                                                         SdcDetected)
+
+        pol = self.health_policy
+        sent = self._health
+        step = loop.iteration
+        flip = health_lib.active_bit_flip() or (-1, 0, 0)
+        if pol.audit_every > 0 and step % pol.audit_every == 0:
+            if self._audit_fn is None:
+                self._audit_fn = health_lib.make_audit_fn(self.mesh)
+            target, element, bit = flip
+            fps = jax.device_get(self._audit_fn(
+                state.params, jnp.int32(target), jnp.int32(element),
+                jnp.int32(bit)))
+            verdict = sent.observe_audit(step, [int(v) for v in fps])
+            self._health_verdict(loop, verdict, "parity audit",
+                                 DeviceQuarantine, SdcDetected)
+        if pol.shadow_every > 0 and step % pol.shadow_every == 0:
+            devices = list(self.mesh.devices.flat)
+            if len(devices) >= 2:
+                verdict = self._shadow_check(step, state, dev_batch,
+                                             devices, flip)
+                self._health_verdict(loop, verdict, "shadow recompute",
+                                     DeviceQuarantine, SdcDetected)
+
+    def _health_verdict(self, loop, verdict, what, quarantine_cls,
+                        sdc_cls) -> None:
+        if verdict.ok:
+            return
+        pol, sent = self.health_policy, self._health
+        if verdict.ambiguous:
+            raise sdc_cls(
+                f"{what} diverged at iteration {loop.iteration} with no "
+                f"attributable minority device (fingerprints "
+                f"{list(verdict.fingerprints)}); corruption is proven "
+                f"but eviction has no target — triage the hardware")
+        if pol.evict and sent.eviction_budget_left:
+            sent.note_quarantine(verdict.suspect, what.replace(" ", "_"))
+            raise quarantine_cls(
+                f"{what} named device {verdict.suspect} as corrupt at "
+                f"iteration {loop.iteration} (fingerprints "
+                f"{list(verdict.fingerprints)}); quarantining — rebuild "
+                f"on the surviving devices and resume from the LKG tier",
+                device=verdict.suspect)
+        logger.error("health: %s named device %s at iteration %d but "
+                     "eviction is %s — continuing (detect-only)", what,
+                     verdict.suspect, loop.iteration,
+                     "off" if not pol.evict else "budget-exhausted")
+
+    def _shadow_check(self, step: int, state: TrainState, dev_batch,
+                      devices, flip):
+        """Re-execute the current microbatch's forward on the shadow
+        device and fingerprint-compare against the primary (a third
+        device votes on a mismatch when the mesh has one).  Host-side by
+        design: the spot-check must NOT share the primary's compiled
+        program or placed arrays — a corrupt device's results re-read
+        from HBM would just agree with themselves."""
+        from analytics_zoo_tpu.resilience import health as health_lib
+
+        pol, sent = self.health_policy, self._health
+        if self._shadow_fn is None:
+            self._shadow_fn = health_lib.make_shadow_fn(
+                self.model.module, forward_fn=self.forward_fn)
+        variables = state_to_variables(mesh_lib.host_local_state(state))
+        host_batch = jax.device_get(dev_batch)
+        target, element, bit = flip
+        shadow_i = min(pol.shadow_device, len(devices) - 1)
+
+        def fp_on(i):
+            with jax.default_device(devices[i]):
+                return int(jax.device_get(self._shadow_fn(
+                    variables, host_batch, jnp.int32(element),
+                    jnp.int32(bit), jnp.bool_(target == i))))
+
+        fp_primary, fp_shadow = fp_on(0), fp_on(shadow_i)
+        tiebreak = None
+        if fp_primary != fp_shadow:
+            third = next((j for j in range(len(devices))
+                          if j not in (0, shadow_i)), None)
+            if third is not None:
+                tiebreak = fp_on(third)
+        return sent.observe_shadow(step, fp_primary, fp_shadow,
+                                   device=shadow_i, tiebreak_fp=tiebreak)
 
     def _anomaly_rollback(self, loop: TrainingState,
                           state: TrainState) -> TrainState:

@@ -14,6 +14,9 @@ itself.  The pieces (see docs/RESILIENCE.md):
   skip → rollback-to-last-known-good → ``TrainingDiverged`` ladder,
   deterministic bad-batch forensics (``tools/replay_batch.py``)
 - :mod:`chaos` — :class:`ChaosMonkey` fault matrix + ``tools/chaos_drill``
+- :mod:`health` — the device-health sentinel: cross-replica parity
+  audit, shadow recompute spot-check, straggler EWMA ladder, and the
+  quarantine/eviction actuators (``tools/sdc_drill``)
 - atomic/verified snapshots live in :mod:`analytics_zoo_tpu.parallel.
   checkpoint`; the restart supervisor in :mod:`analytics_zoo_tpu.
   parallel.elastic`.
@@ -22,10 +25,12 @@ itself.  The pieces (see docs/RESILIENCE.md):
 from analytics_zoo_tpu.resilience.errors import (
     FATAL_ERRORS,
     CheckpointCorrupt,
+    DeviceQuarantine,
     ElasticPlacementError,
     InjectedFault,
     Preempted,
     PrefetchWorkerDied,
+    SdcDetected,
     ShardReadError,
     StallError,
     TrainingDiverged,
@@ -46,6 +51,14 @@ from analytics_zoo_tpu.resilience.chaos import (
     FaultSpec,
     corrupt_snapshot,
     transient_xla_error,
+)
+from analytics_zoo_tpu.resilience.health import (
+    AuditVerdict,
+    HealthPolicy,
+    HealthSentinel,
+    evict_device,
+    make_audit_fn,
+    tree_fingerprint,
 )
 
 __all__ = [k for k in dir() if not k.startswith("_")]

@@ -25,6 +25,14 @@ kind                 effect
                      inf (spike/overflow path of the health word)
 ``corrupt_batch``    deterministically scramble the input payload's raw
                      bytes (a corrupt record surviving decode)
+``bit_flip``         arm a persistent single-bit corruption of ONE named
+                     replica's view of the params/output (silent data
+                     corruption — the device-health parity audit must
+                     name the minority device)
+``slow_device``      persistent per-device slowdown (service-time
+                     multiplier) — unlike the one-shot ``slow_forward``
+                     it never wedges, so only the straggler EWMA
+                     detector catches it
 ===================  ======================================================
 
 The last three are *numerical* faults: instead of raising, they MUTATE
@@ -78,8 +86,35 @@ NUMERICAL_KINDS = ("nan_grads", "inf_loss", "corrupt_batch")
 #:                    ``detail={"rate_x": k}`` inside the window
 SERVING_KINDS = ("slow_forward", "replica_crash", "burst_load")
 
+#: kinds modeling UNHEALTHY SILICON (``resilience.health``):
+#:
+#: ``bit_flip``     fires from the dataset wrapper like a raising kind,
+#:                  but instead of raising it ARMS ``health.arm_bit_flip``
+#:                  (``detail={"replica": r, "element": e, "bit": b}``) —
+#:                  a persistent stuck bit in that device's read path,
+#:                  visible only to the parity audit / shadow recompute
+#: ``slow_device``  consumed by the serving runtime via
+#:                  :meth:`ChaosMonkey.serving_active` (dispatch index,
+#:                  like ``slow_forward``) — ``detail={"replica": r,
+#:                  "slow_x": k}`` multiplies the replica's service time
+#:                  over the window WITHOUT tripping wedge detection
+DEVICE_KINDS = ("bit_flip", "slow_device")
+
 KINDS = ("crash", "xla_transient", "sigterm", "mid_save_kill",
-         "corrupt_latest", "stall") + NUMERICAL_KINDS + SERVING_KINDS
+         "corrupt_latest", "stall") + NUMERICAL_KINDS + SERVING_KINDS \
+    + DEVICE_KINDS
+
+#: accepted ``FaultSpec.detail`` keys per kind — kinds absent here take
+#: no detail at all.  ``__post_init__`` REJECTS unknown keys: a typo'd
+#: knob (``dealy_s``) used to be silently ignored, turning a drill's
+#: fault into a no-op that still "passed".
+_DETAIL_KEYS: Dict[str, frozenset] = {
+    "slow_forward": frozenset({"replica", "delay_s"}),
+    "replica_crash": frozenset({"replica"}),
+    "burst_load": frozenset({"rate_x"}),
+    "bit_flip": frozenset({"replica", "element", "bit"}),
+    "slow_device": frozenset({"replica", "slow_x"}),
+}
 
 
 def _poison_leaf(batch: Dict[str, Any], key: str) -> np.ndarray:
@@ -187,11 +222,17 @@ class FaultSpec:
                              f"one of {KINDS}")
         if self.batches < 1:
             raise ValueError("batches must be >= 1")
-        if self.batches > 1 and self.kind not in (NUMERICAL_KINDS
-                                                  + SERVING_KINDS):
+        windowed = NUMERICAL_KINDS + SERVING_KINDS + ("slow_device",)
+        if self.batches > 1 and self.kind not in windowed:
             raise ValueError(f"batches>1 only applies to windowed kinds "
-                             f"{NUMERICAL_KINDS + SERVING_KINDS}, "
-                             f"not {self.kind!r}")
+                             f"{windowed}, not {self.kind!r}")
+        accepted = _DETAIL_KEYS.get(self.kind, frozenset())
+        unknown = set(self.detail) - accepted
+        if unknown:
+            raise ValueError(
+                f"unknown detail key(s) {sorted(unknown)} for kind "
+                f"{self.kind!r}; accepted: "
+                f"{sorted(accepted) if accepted else '(none)'}")
 
 
 class ChaosMonkey:
@@ -214,6 +255,7 @@ class ChaosMonkey:
         self.consumed = 0          # global batch counter
         self._fired = [False] * len(self.faults)
         self._armed_hook = None    # mid_save_kill hook awaiting a save
+        self._armed_flip = False   # bit_flip armed on the health module
 
     def arm(self, fault: FaultSpec) -> None:
         """Schedule an additional fault mid-run — how a drill targets a
@@ -232,10 +274,14 @@ class ChaosMonkey:
         return ChaosDataset(self, ds)
 
     def _due(self) -> List[int]:
+        # slow_device is serving-consumed (dispatch index) like the
+        # SERVING_KINDS; bit_flip DOES fire from the dataset wrapper
+        # (it arms the health hook instead of raising)
         return [i for i, f in enumerate(self.faults)
                 if not self._fired[i] and f.at_batch <= self.consumed
                 and f.kind not in NUMERICAL_KINDS
-                and f.kind not in SERVING_KINDS]
+                and f.kind not in SERVING_KINDS
+                and f.kind != "slow_device"]
 
     def on_batch(self, batch=None):
         """Fire every due fault (called by the wrapper before each yield)
@@ -314,6 +360,16 @@ class ChaosMonkey:
         self._armed_hook = hook
         ckpt.set_fault_hook(hook)
 
+    def _fire_bit_flip(self, f: FaultSpec, i: int) -> None:
+        from analytics_zoo_tpu.resilience import health
+
+        replica = int(f.detail.get("replica", 0))
+        element = int(f.detail.get("element", 0))
+        bit = int(f.detail.get("bit", 0))
+        health.arm_bit_flip(replica, element=element, bit=bit)
+        self._armed_flip = True
+        self._record(f, replica=replica, element=element, bit=bit)
+
     def _fire_corrupt_latest(self, f: FaultSpec, i: int) -> None:
         if self.checkpoint_path is None:
             raise ValueError("corrupt_latest needs ChaosMonkey("
@@ -341,9 +397,10 @@ class ChaosMonkey:
         exactly one dispatch) and records an event; ``consume=False`` is
         a pure peek (the workload generator probes ``burst_load`` before
         time reaches the window)."""
-        if kind not in SERVING_KINDS:
-            raise ValueError(f"not a serving fault kind: {kind!r}; "
-                             f"one of {SERVING_KINDS}")
+        if kind not in SERVING_KINDS + ("slow_device",):
+            raise ValueError(
+                f"not a serving-consumed fault kind: {kind!r}; one of "
+                f"{SERVING_KINDS + ('slow_device',)}")
         for i, f in enumerate(self.faults):
             if f.kind != kind or self._fired[i]:
                 continue
@@ -358,10 +415,11 @@ class ChaosMonkey:
         return None
 
     def disarm(self) -> None:
-        """Clear a still-armed ``mid_save_kill`` hook.  The hook is a
-        process-global on the checkpoint module; call this when the
-        drill/test ends (whether or not a save ever reached it) so no
-        armed fault leaks into a later job in the same process."""
+        """Clear any still-armed process-global hooks — a
+        ``mid_save_kill`` hook on the checkpoint module and/or a
+        ``bit_flip`` on the health module.  Call when the drill/test
+        ends (whether or not the hook ever fired) so no armed fault
+        leaks into a later job in the same process."""
         from analytics_zoo_tpu.parallel import checkpoint as ckpt
 
         if self._armed_hook is not None:
@@ -369,6 +427,11 @@ class ChaosMonkey:
             if prev is not None and prev is not self._armed_hook:
                 ckpt.set_fault_hook(prev)   # not ours — put it back
             self._armed_hook = None
+        if self._armed_flip:
+            from analytics_zoo_tpu.resilience import health
+
+            health.clear_bit_flip()
+            self._armed_flip = False
 
     def __enter__(self) -> "ChaosMonkey":
         return self

@@ -118,6 +118,33 @@ class ReplicaWedged(RuntimeError):
     handled internally by ``serving.replica.ReplicaPool``."""
 
 
+class DeviceQuarantine(RuntimeError):
+    """The device-health sentinel (``resilience/health.py``) confirmed a
+    specific device as unhealthy — a parity-audit minority vote, a
+    shadow-recompute mismatch with a tiebreak, or a persistent straggler
+    past the hysteresis ladder — and quarantined it.  ``device`` names
+    the flat mesh index (or replica id) being evicted.  Retryable: the
+    culprit is ATTRIBUTED, so the supervisor rebuilds on the surviving
+    devices (``health.evict_device`` + ``SpecSet.replace_mesh`` + LKG
+    tier + ``elastic_resume_coordinates``) and the smaller-width restart
+    does not re-create the fault."""
+
+    def __init__(self, message: str, device=None):
+        super().__init__(message)
+        self.device = device
+
+
+class SdcDetected(RuntimeError):
+    """Silent data corruption was PROVEN (replica fingerprints diverged,
+    or a shadow recompute disagreed with the primary) but could not be
+    attributed to a single device — a two-way split, multiple divergers,
+    or no tiebreak vote.  Fatal by design: with no named culprit there
+    is nothing to evict, and a blind restart lands on the same silicon
+    with corrupted trust in every copy of the params; an operator must
+    triage the hardware (the sentinel's event log carries the
+    per-replica fingerprints)."""
+
+
 class ElasticPlacementError(ValueError):
     """An elastic re-placement asked for a mesh that cannot carry the
     declared sharding: the new mesh's axis names do not cover every axis
@@ -143,6 +170,7 @@ _RETRYABLE_CLASSES: Tuple[Type[BaseException], ...] = (
     ServerOverloaded,
     RequestTimeout,
     ReplicaWedged,
+    DeviceQuarantine,
 )
 
 #: Fatal: restarting cannot fix these (no intact snapshot left; a shard
@@ -152,6 +180,7 @@ FATAL_ERRORS: Tuple[Type[BaseException], ...] = (
     ShardReadError,
     TrainingDiverged,
     ElasticPlacementError,
+    SdcDetected,
 )
 
 

@@ -196,6 +196,10 @@ class Autoscaler:
         #: but no target is returned while held.  A canary burn must
         #: trip the ROLLBACK, not mask itself behind fresh capacity.
         self.hold = False
+        #: devices lost to health quarantines (note_quarantine) — the
+        #: scaler's record of why its ceiling shrank: the pool's
+        #: device_budget decrement is the enforcement, this is the log
+        self.evicted_devices = 0
         self.events: List[Dict[str, Any]] = []
 
     # -- feed ----------------------------------------------------------------
@@ -356,6 +360,17 @@ class Autoscaler:
         self._export(current_size if target is None else target)
         return target
 
+    def note_quarantine(self, replica: int, width: int = 1) -> None:
+        """The runtime quarantined ``replica`` (health eviction): its
+        ``width`` devices left the fleet permanently, unlike a scale-in
+        the next grow could reverse.  Logged so a postmortem can tell an
+        autoscaler decision from a health eviction; the hard ceiling
+        lives in the pool's decremented ``device_budget``."""
+        self.evicted_devices += int(width)
+        self.events.append({"kind": "quarantine", "replica": int(replica),
+                            "width": int(width),
+                            "evicted_devices": self.evicted_devices})
+
     def _export(self, size: int) -> None:
         if self.registry is not None:
             self.registry.gauge("autoscale/replicas").set(float(size))
@@ -369,5 +384,6 @@ class Autoscaler:
             "shrinks": self.shrinks,
             "holds": self.holds,
             "reshapes": self.reshapes,
+            "evicted_devices": self.evicted_devices,
             "actions": list(self.events),
         }

@@ -438,6 +438,31 @@ class ReplicaPool:
                 return r
         return None
 
+    def quarantine(self, rid: int, reason: str = "device_health") -> bool:
+        """Evict one replica's devices from the fleet: drain-then-retire
+        (the ordinary ``resize`` shrink path — in-flight work finishes or
+        re-dispatches once through the failover latch) AND decrement
+        ``device_budget`` by the replica's width, so neither a later
+        ``resize`` grow nor the autoscaler can re-seat anything on the
+        quarantined silicon.  Returns False when ``rid`` is unknown or
+        already draining (idempotent — the health sentinel may flag the
+        same device from several windows)."""
+        r = self.replica_by_rid(rid)
+        if r is None or r.state == "draining":
+            return False
+        width = r.width
+        r.state = "draining"
+        if self.device_budget is not None:
+            self.device_budget = max(self.device_budget - width, 0)
+        self._event({"kind": "replica_quarantined", "replica": rid,
+                     "reason": reason, "width": width,
+                     "device_budget": self.device_budget,
+                     "t": round(self.clock.now(), 6)})
+        logger.warning("pool: replica %d quarantined (%s) — draining; "
+                       "device budget now %s", rid, reason,
+                       self.device_budget)
+        return True
+
     # -- parallel service (the fleet capacity model) --------------------------
     def any_free(self, now: float) -> bool:
         return any(r.busy_until <= now for r in self.healthy())

@@ -238,7 +238,8 @@ class ServingRuntime:
                  retain_requests: bool = True,
                  parallel_replicas: bool = False,
                  slice_width: int = 1,
-                 device_budget: Optional[int] = None):
+                 device_budget: Optional[int] = None,
+                 health=None):
         if models is not None:
             if tiers is not None:
                 raise ValueError("pass tiers= OR models=, not both")
@@ -267,6 +268,12 @@ class ServingRuntime:
         self.decision_every = int(decision_every)
         self.wedge_timeout_s = float(wedge_timeout_s)
         self.chaos = chaos
+        # device-health sentinel (resilience.health.HealthSentinel):
+        # parallel-mode completions feed per-replica service times into
+        # its straggler EWMA ladder; a flagged replica is quarantined
+        # through the pool's drain-then-retire path with device_budget
+        # decremented.  None (default) = zero behavior change.
+        self.health = health
         self.weight_cap = float(weight_cap)
         self.retain_requests = bool(retain_requests)
         # parallel-service mode (the fleet capacity model): dispatch
@@ -1296,14 +1303,19 @@ class ServingRuntime:
             batch_span.end(status="done", redispatched=batch.redispatched)
         self._after_dispatch(batch, t0, failed=False)
 
-    def _parallel_fault(self, replica: Replica) -> Tuple[bool, float]:
+    def _parallel_fault(self, replica: Replica) -> Tuple[bool, float, float]:
         """Chaos windows for the current dispatch index against
         ``replica`` under the parallel service model: ``(crash,
-        delay_s)``.  The windows are the same ``serving_active`` queries
-        the serial ``_fault_for`` composes; here the effects are applied
-        to the replica's OWN busy horizon instead of the shared clock."""
+        delay_s, slow_x)``.  The windows are the same ``serving_active``
+        queries the serial ``_fault_for`` composes; here the effects are
+        applied to the replica's OWN busy horizon instead of the shared
+        clock.  ``slow_x`` (the ``slow_device`` kind) multiplies the
+        SERVICE time — a persistently slow-but-correct device, which
+        deliberately does NOT count as chaotic: it must slip past the
+        wedge/fence checks, because catching it is the straggler
+        detector's job, not the watchdog's."""
         if self.chaos is None:
-            return False, 0.0
+            return False, 0.0, 1.0
         idx = self._dispatch_idx
         delay = 0.0
         spec = self.chaos.serving_active("slow_forward", idx, consume=False)
@@ -1317,7 +1329,13 @@ class ServingRuntime:
                 "replica", replica.rid) == replica.rid:
             self.chaos.serving_active("replica_crash", idx)
             crash = True
-        return crash, delay
+        slow_x = 1.0
+        spec = self.chaos.serving_active("slow_device", idx, consume=False)
+        if spec is not None and spec.detail.get(
+                "replica", replica.rid) == replica.rid:
+            self.chaos.serving_active("slow_device", idx)
+            slow_x = float(spec.detail.get("slow_x", 4.0))
+        return crash, delay, slow_x
 
     def _dispatch_parallel(self, batch: AssembledBatch) -> None:
         """Parallel-service dispatch: assign the batch to a free (or,
@@ -1386,6 +1404,8 @@ class ServingRuntime:
                      elapsed: float) -> None:
             completion = start + elapsed
             replica.busy_until = completion
+            if self.health is not None:
+                self._note_device_health(replica, elapsed)
             rows = np.asarray(out)
             self._maybe_canary(batch, rows, now)
             for i, req in enumerate(batch.requests):
@@ -1432,7 +1452,7 @@ class ServingRuntime:
             for req in batch.requests:
                 req.attempts += 1
             replica.dispatches += 1
-            crash, delay = self._parallel_fault(replica)
+            crash, delay, slow_x = self._parallel_fault(replica)
             start = max(t_avail, replica.busy_until)
             budget = replica.fence_budget_s
             chaotic = crash or delay > 0
@@ -1473,7 +1493,11 @@ class ServingRuntime:
             if tax > 0 and replica.warm_keys is not None:
                 replica.warm_keys.add((batch.model, batch.edge,
                                        batch.tier))
-            service = float(self._service_hook(batch, replica.rid))
+            # slow_device stretches the service itself (the device
+            # computes correctly, just slowly) and stays OUT of
+            # `chaotic`: no wedge, no fence — only the straggler EWMA
+            # sees it, through the health feed in complete()
+            service = float(self._service_hook(batch, replica.rid)) * slow_x
             elapsed = delay + tax + service
             if chaotic and budget is not None and elapsed > budget:
                 # fence-budget semantics on the replica's OWN busy
@@ -1536,6 +1560,30 @@ class ServingRuntime:
                    if batch.affinity is not None else "")), now)
             return
         serve_on(replica, now, is_backup=False)
+
+    def _note_device_health(self, replica: Replica, elapsed: float) -> None:
+        """Feed one completed dispatch's per-replica elapsed time into
+        the straggler EWMA ladder; when the ladder flags the replica
+        (persistently over ``straggler_factor`` × the fleet median for
+        ``flag_after`` windows), quarantine it: drain-then-retire with
+        ``device_budget`` decremented, so capacity recovers on healthy
+        silicon and nothing re-seats on the slow device."""
+        flagged = self.health.observe_step_time(replica.rid, float(elapsed))
+        if flagged is None:
+            return
+        pol = self.health.policy
+        if not (pol.evict and self.health.eviction_budget_left):
+            logger.warning("health: replica %d flagged as straggler but "
+                           "eviction is %s — serving continues degraded",
+                           flagged,
+                           "off" if not pol.evict else "budget-exhausted")
+            return
+        victim = self.pool.replica_by_rid(flagged)
+        width = victim.width if victim is not None else 1
+        if self.pool.quarantine(flagged, reason="straggler"):
+            self.health.note_quarantine(flagged, "straggler")
+            if self.autoscaler is not None:
+                self.autoscaler.note_quarantine(flagged, width)
 
     def _after_dispatch(self, batch: AssembledBatch, t0: float,
                         failed: bool) -> None:

@@ -1071,3 +1071,102 @@ class TestAzTrace:
         assert done_trace is not None
         assert az.main(["--flight", str(flight), "--critical-path",
                         done_trace]) == 0
+
+
+class TestSdcDrillArtifact:
+    """ISSUE 20: the committed SDC_r01.json artifact's claims (the full
+    drill injects a single bit-flip into one replica's audit view
+    mid-epoch, detects it by cross-replica parity within one audit
+    interval, evicts the device, resumes checkpoint-free from the LKG
+    tier at width 2 with finals matching the fault-free reference, and
+    quarantines a slow serving device after EWMA hysteresis)."""
+
+    def test_committed_sdc_artifact_banks_the_claims(self):
+        import json
+
+        from tools.check_artifacts import LEGACY, PATTERN, REQUIRED_KEYS
+
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "SDC_r01.json")
+        report = json.load(open(path))
+        assert report["verdict"] == "PASS"
+        sdc = report["sdc_training"]
+        assert sdc["checks"]["ok"] and all(sdc["checks"].values()), \
+            sdc["checks"]
+        det, cfg = sdc["detection"], sdc["config"]
+        # the detection-latency bound: strictly within one audit interval
+        assert 0 < det["latency_steps"] <= cfg["audit_every"]
+        # the parity vote named exactly the injected replica — one
+        # diverging fingerprint, held by the suspect alone
+        assert det["suspect"] == sdc["fault"]["replica"]
+        assert det["minority"] == [det["suspect"]]
+        fps = det["fingerprints"]
+        assert len(fps) == cfg["world_width"]
+        assert len(set(fps)) == 2
+        assert fps.count(fps[det["suspect"]]) == 1
+        # checkpoint-free recovery: LKG tier, width 4 -> 2
+        res = sdc["resume"]
+        assert res["from_tier"] == "lkg"
+        assert res["saved_world_width"] == 4
+        assert res["resumed_world_width"] == 2
+        assert sdc["eviction"]["evicted_device"] == det["suspect"]
+        fin = sdc["finals"]
+        assert fin["iterations_faulted"] == fin["iterations_reference"]
+        assert fin["params_max_abs_diff"] <= \
+            cfg["rel_tol"] * max(fin["params_ref_max_abs"], 1e-6)
+        # fault-free arm: a full run of audits with ZERO false positives
+        ff = sdc["sentinel_fault_free"]
+        assert ff["audits"] > 0
+        assert ff["audit_divergences"] == 0 and ff["quarantines"] == 0
+        # straggler serving half: flag exactly at the hysteresis ladder,
+        # drain-then-retire, device budget decremented once
+        st = report["straggler_serving"]
+        assert st["checks"]["ok"] and all(st["checks"].values()), \
+            st["checks"]
+        assert st["flag_events"][0]["streak"] == \
+            st["config"]["policy"]["flag_after"]
+        q = st["quarantine_events"][0]
+        assert q["reason"] == "straggler"
+        assert q["device_budget"] == st["config"]["device_budget"] - 1
+        assert st["retire_events"][0]["replica"] == q["replica"]
+        assert st["sentinel_fault_free"]["straggler_flags"] == 0
+        assert st["accounting"]["unaccounted"] == 0
+        # replay determinism: both segments re-ran byte-identically
+        rep = report["replay"]
+        assert rep["sdc_identical"] is True
+        assert rep["straggler_identical"] is True
+        assert len(rep["sdc_digest"]) == len(rep["straggler_digest"]) == 64
+        assert report["fault_kinds_survived"] == ["bit_flip", "slow_device"]
+        # governed by the artifact lint as STAMPED, not grandfathered
+        assert PATTERN.match("SDC_r01.json")
+        assert "SDC_r01.json" not in LEGACY
+        meta = report["run_metadata"]
+        assert all(k in meta for k in REQUIRED_KEYS)
+
+    def test_chaos_matrix_covers_every_kind(self):
+        """The all-kinds-survived claim spans the FULL ``KINDS`` tuple:
+        every chaos kind is exercised by a banked drill artifact or by
+        the in-process injection probe below.  Adding a kind to KINDS
+        without drill coverage fails here."""
+        import json
+
+        from analytics_zoo_tpu.resilience.chaos import KINDS, mutate_batch
+
+        root = os.path.join(os.path.dirname(__file__), os.pardir)
+        banked = set()
+        for name in ("RESILIENCE_r02.json", "SDC_r01.json"):
+            with open(os.path.join(root, name)) as f:
+                banked |= set(json.load(f)["fault_kinds_survived"])
+        with open(os.path.join(root, "RESILIENCE_r03.json")) as f:
+            banked |= {s["kind"] for s in json.load(f)["fault_schedule"]}
+        # inf_loss rides the in-graph anomaly ladder (test_anomaly.py's
+        # end-to-end run); back the matrix claim with the injection
+        # itself firing here, not just a listing
+        batch = {"input": np.zeros((2, 2), np.float32),
+                 "target": np.zeros((2, 1), np.float32)}
+        poisoned = mutate_batch("inf_loss", batch, seed=0)
+        with np.errstate(over="ignore"):
+            assert np.square(poisoned["target"]).max() == np.inf
+        banked.add("inf_loss")
+        missing = set(KINDS) - banked
+        assert not missing, f"chaos kinds with no drill coverage: {missing}"
