@@ -812,6 +812,12 @@ class Optimizer:
             # the device finishes (see set_observability docstring)
             step_timer = StepTimer("train/dispatch", registry=obs.registry)
         self._health = None
+        # the jitted audit/shadow programs close over the mesh and the
+        # forward fn — a reused Optimizer may have swapped either (the
+        # elastic replace_mesh path), so they rebuild per optimize()
+        # alongside the sentinel, never across calls
+        self._audit_fn = None
+        self._shadow_fn = None
         if (self.health_policy is not None
                 and (self.health_policy.audit_every > 0
                      or self.health_policy.shadow_every > 0)):

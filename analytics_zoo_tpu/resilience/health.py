@@ -124,7 +124,10 @@ def tree_fingerprint(tree, flip=None):
     XLA, so the fold is exact and deterministic; leaf order is jax's
     canonical tree order).  Any single-element change anywhere in the
     tree changes the word with overwhelming probability, and a one-BIT
-    change always changes the folded leaf's term (bitcast, weight ≠ 0).
+    change ALWAYS changes the folded leaf's term (bitcast + per-position
+    odd weight: flipping bit ``b`` of a word perturbs the fold by
+    ``±2^b·w mod 2^32``, which is non-zero for every ``b < 32`` exactly
+    because ``w`` is odd).
 
     ``flip`` (optional) = ``(element, bit, on)`` traced scalars: when
     ``on`` is true, flat ``element`` of the FIRST leaf has ``bit``
@@ -142,12 +145,16 @@ def tree_fingerprint(tree, flip=None):
             flipped = u.at[idx].set(
                 u[idx] ^ (jnp.uint32(1) << jnp.uint32(bit)))
             u = jnp.where(on, flipped, u)
-        # per-position odd weights (Knuth multiplicative hash) so
-        # element swaps and leaf reorders change the word too
-        w = (jnp.arange(u.size, dtype=jnp.uint32) * jnp.uint32(2654435761)
-             + jnp.uint32(2 * k + 1))
-        word = word * jnp.uint32(16777619) + jnp.sum(u * w,
-                                                     dtype=jnp.uint32)
+        # per-position Knuth-hash weights FORCED odd (|1): an even
+        # weight is blind to high bits (2^b·w ≡ 0 mod 2^32 once
+        # w ≡ 0 mod 2^(32-b)) — the old idx·K + (2k+1) scheme was even
+        # at every odd idx and so missed sign-bit flips there.  The
+        # leaf index mixes into the fold as its own odd term instead,
+        # keeping leaf reorders visible.
+        w = ((jnp.arange(u.size, dtype=jnp.uint32)
+              * jnp.uint32(2654435761)) | jnp.uint32(1))
+        word = (word * jnp.uint32(16777619) + jnp.uint32(2 * k + 1)
+                + jnp.sum(u * w, dtype=jnp.uint32))
     return word
 
 
@@ -458,10 +465,16 @@ class HealthSentinel:
 
     # -- bookkeeping -------------------------------------------------------
     def note_quarantine(self, device: int, reason: str) -> None:
-        """Record an actuated eviction (the caller raises/retires)."""
+        """Record an actuated eviction (the caller raises/retires) and
+        drop the device's straggler state — a retired device's inflated
+        EWMA must not keep counting as a peer in the fleet median, where
+        it would skew every later outlier decision."""
+        device = int(device)
         self.quarantines += 1
         self._count("health/quarantines")
-        self.events.append({"kind": "quarantine", "device": int(device),
+        for m in (self._ewma, self._obs, self._streak, self._clean):
+            m.pop(device, None)
+        self.events.append({"kind": "quarantine", "device": device,
                             "reason": reason})
 
     @property

@@ -1401,11 +1401,16 @@ class ServingRuntime:
                 self._decide_window()
 
         def complete(replica: Replica, out: Any, start: float,
-                     elapsed: float) -> None:
+                     elapsed: float, service: float) -> None:
             completion = start + elapsed
             replica.busy_until = completion
             if self.health is not None:
-                self._note_device_health(replica, elapsed)
+                # only the SERVICE component feeds the straggler EWMA:
+                # injected slow_forward delay and cold-start warm tax
+                # are not the silicon's speed, and eviction is
+                # irreversible — a replica paying warm taxes for new
+                # (model, edge, tier) keys must not be flagged for it
+                self._note_device_health(replica, service)
             rows = np.asarray(out)
             self._maybe_canary(batch, rows, now)
             for i, req in enumerate(batch.requests):
@@ -1516,7 +1521,7 @@ class ServingRuntime:
                     f"{replica.watchdog.timeout_s:.3f}s deadline)"),
                     start + elapsed, is_backup)
                 return
-            complete(replica, out, start, elapsed)
+            complete(replica, out, start, elapsed, service)
 
         def failover(failed: Replica, err: ReplicaWedged,
                      t_detect: float, is_backup: bool) -> None:
@@ -1562,8 +1567,11 @@ class ServingRuntime:
         serve_on(replica, now, is_backup=False)
 
     def _note_device_health(self, replica: Replica, elapsed: float) -> None:
-        """Feed one completed dispatch's per-replica elapsed time into
-        the straggler EWMA ladder; when the ladder flags the replica
+        """Feed one completed dispatch's per-replica SERVICE time (the
+        post-``slow_x`` compute component only — excluding injected
+        ``slow_forward`` delay and cold-start warm tax, which would
+        falsely flag healthy silicon) into the straggler EWMA ladder;
+        when the ladder flags the replica
         (persistently over ``straggler_factor`` × the fleet median for
         ``flag_after`` windows), quarantine it: drain-then-retire with
         ``device_budget`` decremented, so capacity recovers on healthy

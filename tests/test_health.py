@@ -80,6 +80,37 @@ class TestFingerprint:
         raw[0, 0] ^= np.uint32(1 << 3)
         assert int(f(flipped)) != w1
 
+    def test_sign_bit_flip_at_odd_index_changes_word(self):
+        # regression pin: the pre-fix weight idx·K + (2k+1) was EVEN at
+        # every odd flat index (odd·odd + odd), so 2^31·w ≡ 0 mod 2^32
+        # and the fold was blind to float32 sign-bit SDC at half of all
+        # positions; odd-forced weights make every bit land
+        import jax
+
+        tree = {"a": np.arange(8, dtype=np.float32)}
+        f = jax.jit(tree_fingerprint)
+        clean = int(f(tree))
+        for idx in (1, 3, 5, 7):
+            flipped = {"a": tree["a"].copy()}
+            flipped["a"].view(np.uint32)[idx] ^= np.uint32(1 << 31)
+            assert int(f(flipped)) != clean, f"blind to sign bit @ {idx}"
+
+    def test_every_single_bit_flip_changes_word(self):
+        # exhaustive single-bit sensitivity over a small two-leaf tree:
+        # all (leaf, element, bit) corruptions must perturb the fold
+        import jax
+
+        tree = {"a": np.arange(6, dtype=np.float32),
+                "b": np.ones((3,), np.float32)}
+        f = jax.jit(tree_fingerprint)
+        clean = int(f(tree))
+        for leaf in ("a", "b"):
+            for idx in range(tree[leaf].size):
+                for bit in range(32):
+                    t = {k: v.copy() for k, v in tree.items()}
+                    t[leaf].view(np.uint32)[idx] ^= np.uint32(1 << bit)
+                    assert int(f(t)) != clean, (leaf, idx, bit)
+
     def test_traced_flip_matches_manual_flip(self):
         import jax
         import jax.numpy as jnp
@@ -121,6 +152,13 @@ class TestFingerprint:
                    for i in range(width) if i != 2)
         v = HealthSentinel().observe_audit(0, [int(x) for x in flipped])
         assert not v.ok and v.suspect == 2
+        # sign-bit SDC at an ODD element — the pre-fix even-weight
+        # blind spot — must diverge the target replica just the same
+        sign = np.asarray(audit(params, jnp.int32(1), jnp.int32(3),
+                                jnp.int32(31)))
+        assert int(sign[1]) != int(clean[1])
+        assert all(int(sign[i]) == int(clean[i])
+                   for i in range(width) if i != 1)
 
     def test_audit_fn_rejects_hybrid_mesh(self):
         from analytics_zoo_tpu.parallel import mesh as mesh_lib
@@ -262,6 +300,23 @@ class TestStragglerHysteresis:
         assert not s.eviction_budget_left
         assert s.stats()["quarantines"] == 1
 
+    def test_quarantine_drops_device_from_fleet_median(self):
+        # regression pin: a retired device's inflated EWMA must not
+        # keep counting as a peer — with device 2's 1.0s EWMA still in
+        # the pool, device 0's 0.12s would sit under the skewed median
+        # (0.525s × factor) and the outlier would be masked
+        pol = HealthPolicy(straggler_factor=2.0, flag_after=1,
+                           warmup_obs=1, straggler_alpha=1.0)
+        s = HealthSentinel(pol)
+        for _ in range(2):
+            assert s.observe_step_time(0, 0.05) is None
+            assert s.observe_step_time(1, 0.05) is None
+            s.observe_step_time(2, 1.0)
+        assert s.flagged() == [2]
+        s.note_quarantine(2, "straggler")
+        assert 2 not in s._ewma and 2 not in s._obs
+        assert s.observe_step_time(0, 0.12) == 0
+
 
 class TestFaultSpecDetailValidation:
     def test_typod_key_rejected_with_accepted_set(self):
@@ -368,6 +423,105 @@ class TestReplicaPoolQuarantine:
         assert pool.quarantine(1) is False    # already draining
         assert pool.quarantine(99) is False   # unknown rid
         assert pool.device_budget == 2        # decremented exactly once
+
+
+class TestServingHealthFeed:
+    def test_injected_delay_and_warm_tax_do_not_flag(self):
+        # regression pin: the straggler EWMA must see only the SERVICE
+        # component — a replica paying chaos slow_forward delays (and
+        # cold-start warm taxes) is healthy silicon, and eviction is
+        # irreversible.  Pre-fix, elapsed = delay + tax + service fed
+        # the ladder and replica 2 here was falsely quarantined.
+        import random
+
+        from analytics_zoo_tpu.resilience.chaos import (ChaosMonkey,
+                                                        FaultSpec)
+        from analytics_zoo_tpu.serving import ServingRuntime, VirtualClock
+        from analytics_zoo_tpu.serving.ladder import ServingTier
+
+        n, service_s = 90, 0.05
+
+        def fwd(batch):
+            return np.zeros((np.asarray(batch["input"]).shape[0], 1),
+                            np.float32)
+
+        clock = VirtualClock()
+        monkey = ChaosMonkey([FaultSpec(
+            "slow_forward", 0, batches=10**6,
+            detail={"replica": 2, "delay_s": 0.2})])
+        sentinel = HealthSentinel(HealthPolicy(
+            straggler_factor=2.0, straggler_alpha=0.25, flag_after=2,
+            warmup_obs=1, evict=True, max_evictions=1))
+        rt = ServingRuntime(
+            [ServingTier("fp", fwd, speed=1.0)], n_replicas=3,
+            clock=clock, queue_capacity=n, max_batch=1,
+            default_deadline_s=30.0,
+            service_time=lambda edge, n_, tier: service_s,
+            decision_every=10**9, shed_expired=False, chaos=monkey,
+            health=sentinel, parallel_replicas=True, device_budget=3)
+        rng = random.Random(0)
+        t = 0.0
+        arrivals = []
+        for _ in range(n):
+            t += rng.expovariate(1.0 / 0.045)
+            arrivals.append(t)
+        i = 0
+        while i < n:
+            now = clock.now()
+            if now < arrivals[i]:
+                if rt.pump() == 0:
+                    ev = rt.next_event_t()
+                    target = (arrivals[i] if ev is None
+                              else min(ev, arrivals[i]))
+                    clock.advance(max(target - now, 1e-9))
+                continue
+            while i < n and clock.now() >= arrivals[i]:
+                rt.submit({"input": np.zeros((1, 4), np.float32)},
+                          deadline_s=30.0)
+                i += 1
+            rt.pump()
+        for _ in range(100_000):
+            if len(rt.queue) == 0:
+                break
+            if rt.pump() == 0:
+                ev = rt.next_event_t()
+                clock.advance(max((ev - clock.now()) if ev is not None
+                                  else 0.05, 1e-9))
+        rt.drain()
+        acct = rt.accounting()
+        assert acct["unaccounted"] == 0
+        assert sentinel.stats()["straggler_flags"] == 0
+        assert sentinel.stats()["quarantines"] == 0
+        assert not any(e["kind"] == "replica_quarantined"
+                       for e in rt.pool.events)
+
+
+class TestOptimizerHealthProgramCache:
+    def test_stale_audit_programs_invalidated_per_optimize(self):
+        # regression pin: _audit_fn/_shadow_fn close over the mesh and
+        # forward fn — a reused Optimizer whose mesh was swapped (the
+        # elastic replace_mesh path) must not audit against the stale
+        # one, so optimize() drops the cache alongside the sentinel
+        from flax import linen as nn
+        import jax
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.core.criterion import MSECriterion
+        from analytics_zoo_tpu.core.module import Model
+        from analytics_zoo_tpu.parallel import SGD, Optimizer, Trigger
+
+        m = Model(nn.Dense(1))
+        m.build(0, jnp.zeros((1, 4), jnp.float32))
+        b = jax.device_count()
+        data = [{"input": np.zeros((b, 4), np.float32),
+                 "target": np.zeros((b, 1), np.float32)}]
+        opt = (Optimizer(m, data, MSECriterion())
+               .set_optim_method(SGD(0.05))
+               .set_end_when(Trigger.max_epoch(1)))
+        stale = object()
+        opt._audit_fn = opt._shadow_fn = stale
+        opt.optimize()
+        assert opt._audit_fn is None and opt._shadow_fn is None
 
 
 class TestHealthMetricNames:
